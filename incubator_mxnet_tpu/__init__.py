@@ -1,0 +1,27 @@
+"""incubator_mxnet_tpu — a TPU-native deep-learning framework with the
+capabilities of Apache MXNet 1.2 (reference: jinhuang415/incubator-mxnet).
+
+Not a port: JAX/XLA is the compile+execute substrate, Pallas the custom-kernel
+path, pjit/shard_map + XLA collectives the distributed fabric.  See SURVEY.md
+at the repo root for the blueprint and per-module docstrings for the
+reference-parity map (file:line citations into /root/reference).
+
+Import convention mirrors the reference:
+
+    import incubator_mxnet_tpu as mx
+    x = mx.nd.zeros((2, 3), ctx=mx.tpu(0))
+"""
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from . import context
+from .context import Context, cpu, gpu, tpu, current_context, num_devices
+
+from . import ops
+from . import ndarray
+from . import ndarray as nd  # canonical alias, as in mxnet
+from .ndarray import NDArray
+
+from . import autograd
+from . import random
+from . import random_state

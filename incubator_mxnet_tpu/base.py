@@ -1,0 +1,71 @@
+"""Shared base utilities for the TPU-native framework.
+
+Plays the role of the reference's ``python/mxnet/base.py`` (ctypes bridge,
+error type, name manager) — but there is no C library to load: the compute
+substrate is JAX/XLA, so "the library" is the in-process op registry
+(see ``ops/registry.py``). Reference: python/mxnet/base.py:1-120.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = ["MXNetError", "NameManager", "string_types", "numeric_types"]
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (parity with mxnet.base.MXNetError)."""
+
+
+class NameManager:
+    """Automatic unique-name assignment for symbols/blocks.
+
+    Mirrors python/mxnet/name.py: a thread-local stack of managers;
+    ``get(None, hint)`` manufactures ``hint0, hint1, ...``.
+    """
+
+    _local = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+        self._old = None
+
+    def get(self, name, hint):
+        if name is not None:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = "%s%d" % (hint, self._counter[hint])
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        if not hasattr(NameManager._local, "stack"):
+            NameManager._local.stack = [NameManager()]
+        NameManager._local.stack.append(self)
+        return self
+
+    def __exit__(self, *args):
+        NameManager._local.stack.pop()
+
+    @staticmethod
+    def current():
+        if not hasattr(NameManager._local, "stack"):
+            NameManager._local.stack = [NameManager()]
+        return NameManager._local.stack[-1]
+
+
+class Prefix(NameManager):
+    """Name manager that always attaches a prefix (mxnet.name.Prefix)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name

@@ -1,0 +1,98 @@
+"""`nd` namespace: NDArray + one generated function per registered operator.
+
+Parity surface: python/mxnet/ndarray/__init__.py + ndarray.py + utils.py.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from ..context import Context, current_context, cpu
+from ..ops.registry import get_op
+from .ndarray import (NDArray, array, empty, invoke, waitall, concatenate,
+                      moveaxis, imperative_invoke)
+from . import register as _register
+from . import ndarray as _ndarray_mod
+
+
+# -- explicit creation wrappers (pythonic signatures over the raw ops) ------
+
+def zeros(shape, ctx=None, dtype="float32", stype=None, **kwargs):
+    """ref: python/mxnet/ndarray/utils.py zeros"""
+    if isinstance(shape, int):
+        shape = (shape,)
+    if stype not in (None, "default"):
+        from . import sparse
+        return sparse.zeros(stype, shape, ctx=ctx, dtype=dtype)
+    return invoke(get_op("_zeros"), [], {"shape": tuple(shape), "dtype": np.dtype(dtype).name,
+                                         "ctx": ctx})
+
+
+def ones(shape, ctx=None, dtype="float32", **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return invoke(get_op("_ones"), [], {"shape": tuple(shape), "dtype": np.dtype(dtype).name,
+                                        "ctx": ctx})
+
+
+def full(shape, val, ctx=None, dtype="float32", out=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return invoke(get_op("_full"), [], {"shape": tuple(shape), "value": float(val),
+                                        "dtype": np.dtype(dtype).name, "ctx": ctx}, out=out)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    return invoke(get_op("_arange"), [], {"start": start, "stop": stop, "step": step,
+                                          "repeat": repeat, "dtype": np.dtype(dtype).name,
+                                          "ctx": ctx})
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32"):
+    return invoke(get_op("_eye"), [], {"N": N, "M": M, "k": k,
+                                       "dtype": np.dtype(dtype).name, "ctx": ctx})
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype="float32"):
+    return invoke(get_op("_linspace"), [], {"start": start, "stop": stop, "num": num,
+                                            "endpoint": endpoint,
+                                            "dtype": np.dtype(dtype).name, "ctx": ctx})
+
+
+def zeros_like(data, **kwargs):
+    return invoke(get_op("zeros_like"), [data], {})
+
+
+def ones_like(data, **kwargs):
+    return invoke(get_op("ones_like"), [data], {})
+
+
+def save(fname, data):
+    """Save NDArrays (ref: NDArray::Save, src/ndarray/ndarray.cc) — .npz based."""
+    from .utils import save as _save
+    return _save(fname, data)
+
+
+def load(fname):
+    from .utils import load as _load
+    return _load(fname)
+
+
+def onehot_encode(indices, out):
+    """legacy helper (ref: python/mxnet/ndarray/ndarray.py onehot_encode)."""
+    depth = out.shape[1]
+    res = invoke(get_op("one_hot"), [indices], {"depth": depth})
+    out._write(res._read().astype(out.dtype))
+    return out
+
+
+# auto-generate the remaining op surface
+_register.populate(globals())
+_register.module_surface = sys.modules[__name__]
+
+# expose submodule-style accessors for parity: nd.random, nd.linalg
+from . import random  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
+
+NDArray = NDArray  # re-export for clarity

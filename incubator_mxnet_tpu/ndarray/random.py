@@ -1,0 +1,70 @@
+"""nd.random namespace (parity: python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.registry import get_op
+from .ndarray import NDArray, invoke
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def _simple(op_name, params, shape, dtype, ctx, out):
+    params = dict(params)
+    params["shape"] = _shape(shape)
+    params["dtype"] = np.dtype(dtype if dtype not in (None, "None") else "float32").name
+    params["ctx"] = ctx
+    return invoke(get_op(op_name), [], params, out=out)
+
+
+def uniform(low=0, high=1, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    if isinstance(low, NDArray):
+        return invoke(get_op("_sample_uniform"), [low, high], {"shape": _shape(shape)}, out=out)
+    return _simple("_random_uniform", {"low": float(low), "high": float(high)},
+                   shape, dtype, ctx, out)
+
+
+def normal(loc=0, scale=1, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    if isinstance(loc, NDArray):
+        return invoke(get_op("_sample_normal"), [loc, scale], {"shape": _shape(shape)}, out=out)
+    return _simple("_random_normal", {"loc": float(loc), "scale": float(scale)},
+                   shape, dtype, ctx, out)
+
+
+def gamma(alpha=1, beta=1, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    return _simple("_random_gamma", {"alpha": float(alpha), "beta": float(beta)},
+                   shape, dtype, ctx, out)
+
+
+def exponential(lam=1, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    return _simple("_random_exponential", {"lam": float(lam)}, shape, dtype, ctx, out)
+
+
+def poisson(lam=1, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    return _simple("_random_poisson", {"lam": float(lam)}, shape, dtype, ctx, out)
+
+
+def negative_binomial(k=1, p=1, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    return _simple("_random_negative_binomial", {"k": int(k), "p": float(p)},
+                   shape, dtype, ctx, out)
+
+
+def generalized_negative_binomial(mu=1, alpha=1, shape=None, dtype=None, ctx=None,
+                                  out=None, **kwargs):
+    return _simple("_random_generalized_negative_binomial",
+                   {"mu": float(mu), "alpha": float(alpha)}, shape, dtype, ctx, out)
+
+
+def multinomial(data, shape=None, get_prob=False, out=None, dtype="int32", **kwargs):
+    return invoke(get_op("_sample_multinomial"), [data],
+                  {"shape": _shape(shape), "get_prob": get_prob, "dtype": dtype}, out=out)
+
+
+def shuffle(data, **kwargs):
+    return invoke(get_op("_shuffle"), [data], {})

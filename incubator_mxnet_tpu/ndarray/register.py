@@ -1,0 +1,54 @@
+"""Auto-generation of the ``nd.<op>`` function surface.
+
+Parity with python/mxnet/ndarray/register.py:29 — the reference code-gens one
+Python function per registered operator at import time by querying the C API;
+we do the same over the in-process registry.  Each generated function splits
+NDArray arguments from attribute kwargs and funnels into
+``ndarray.invoke`` (the imperative runtime).
+"""
+from __future__ import annotations
+
+import keyword
+
+from ..ops.registry import _REGISTRY, Operator
+from .ndarray import NDArray, invoke
+
+module_surface = None  # set by ndarray/__init__ (used for method dispatch)
+
+
+def make_op_func(op_name: str, op: Operator):
+    def generic_op(*args, out=None, name=None, **kwargs):
+        arrays = []
+        rest = list(args)
+        while rest and isinstance(rest[0], NDArray):
+            arrays.append(rest.pop(0))
+        if rest:
+            # allow trailing scalars for ops like slice_axis(data, axis, b, e)?
+            raise TypeError(
+                "%s: positional arguments after NDArrays must be keyword "
+                "attributes, got %r" % (op_name, rest))
+        if op.input_names:
+            for n in op.input_names:
+                v = kwargs.pop(n, None)
+                if isinstance(v, NDArray):
+                    arrays.append(v)
+        else:
+            for k in list(kwargs):
+                if isinstance(kwargs[k], NDArray):
+                    arrays.append(kwargs.pop(k))
+        return invoke(op, arrays, kwargs, out=out)
+
+    generic_op.__name__ = op_name
+    generic_op.__qualname__ = op_name
+    generic_op.__doc__ = (op.doc or "") + "\n\n(auto-generated from op registry; " \
+        "parity: python/mxnet/ndarray/register.py codegen)"
+    return generic_op
+
+
+def populate(namespace: dict):
+    for name, op in list(_REGISTRY.items()):
+        if keyword.iskeyword(name) or not name.replace("_", "a").isidentifier():
+            continue
+        if name in namespace:
+            continue
+        namespace[name] = make_op_func(name, op)

@@ -1,0 +1,210 @@
+"""Sparse NDArrays: RowSparse and CSR.
+
+TPU-native design for the reference's first-class sparse storage types
+(include/mxnet/ndarray.h:61-65, src/operator/tensor/cast_storage*,
+dot-inl.h sparse kernels).  XLA has no native sparse tensors, so — per
+SURVEY §7 hard part #3 — sparse arrays here are *structs of dense device
+arrays* (values + indices), with compute lowered to gather/scatter/segment
+ops that XLA maps well to TPU (dense row gathers feed the MXU; scatters use
+sorted segment sums).  The API (stype, .data/.indices/.indptr, tostype,
+retain) matches python/mxnet/ndarray/sparse.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..context import current_context
+from .ndarray import NDArray, array as _dense_array
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "cast_storage", "zeros", "retain"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common surface for sparse arrays (parity: sparse.py BaseSparseNDArray)."""
+
+    def __init__(self, shape, ctx=None):
+        # no dense root buffer; subclasses hold component NDArrays
+        super().__init__(data=None, ctx=ctx)
+        self._shape = tuple(shape)
+
+    def _read(self):
+        return self.todense()._read()
+
+    def _write(self, value):
+        raise TypeError("in-place writes on sparse NDArray are not supported")
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def todense(self):
+        raise NotImplementedError
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self.todense()
+        if stype == self.stype:
+            return self
+        return cast_storage(self, stype)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse: (indices[K], values[K, ...]) — K occupied rows.
+
+    ref: python/mxnet/ndarray/sparse.py RowSparseNDArray; used for sparse
+    gradients of Embedding/FullyConnected and KVStore row_sparse_pull.
+    """
+
+    def __init__(self, data, indices, shape, ctx=None):
+        super().__init__(shape, ctx=ctx)
+        self.data = data          # NDArray (K, *shape[1:])
+        self.indices = indices    # NDArray (K,) int64, sorted unique
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    def todense(self):
+        dense = jnp.zeros(self._shape, self.data._read().dtype)
+        idx = self.indices._read().astype(jnp.int32)
+        dense = dense.at[idx].set(self.data._read())
+        return NDArray(dense, ctx=self._ctx)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray) and not isinstance(other, BaseSparseNDArray):
+            other._write(self.todense()._read())
+            return other
+        return RowSparseNDArray(self.data.copy(), self.indices.copy(),
+                                self._shape, ctx=self._ctx)
+
+    def __repr__(self):
+        return "\n<RowSparseNDArray %s @%s>" % (
+            "x".join(str(s) for s in self._shape), self._ctx)
+
+    def retain(self, row_ids):
+        return retain(self, row_ids)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (ref: sparse.py CSRNDArray)."""
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        super().__init__(shape, ctx=ctx)
+        self.data = data        # (nnz,)
+        self.indices = indices  # (nnz,) column ids
+        self.indptr = indptr    # (rows+1,)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    def todense(self):
+        m, n = self._shape
+        d = self.data._read()
+        col = self.indices._read().astype(jnp.int32)
+        ptr = self.indptr._read().astype(jnp.int32)
+        # row id per nnz via searchsorted on indptr
+        nnz = d.shape[0]
+        row = jnp.searchsorted(ptr, jnp.arange(nnz), side="right") - 1
+        dense = jnp.zeros((m, n), d.dtype).at[row, col].set(d)
+        return NDArray(dense, ctx=self._ctx)
+
+    def __repr__(self):
+        return "\n<CSRNDArray %s @%s>" % (
+            "x".join(str(s) for s in self._shape), self._ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """ref: sparse.py row_sparse_array — from (data, indices) or dense."""
+    ctx = ctx or current_context()
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = data if isinstance(data, NDArray) else _dense_array(np.asarray(data), ctx=ctx, dtype=dtype)
+        indices = indices if isinstance(indices, NDArray) else _dense_array(
+            np.asarray(indices), ctx=ctx, dtype=np.int64)
+        if shape is None:
+            raise ValueError("shape required when building from (data, indices)")
+        return RowSparseNDArray(data, indices, tuple(shape), ctx=ctx)
+    # dense source
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
+    return cast_storage(_dense_array(src, ctx=ctx, dtype=dtype), "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """ref: sparse.py csr_matrix — from (data, indices, indptr) or dense."""
+    ctx = ctx or current_context()
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        mk = lambda x, dt=None: x if isinstance(x, NDArray) else _dense_array(
+            np.asarray(x), ctx=ctx, dtype=dt)
+        if shape is None:
+            raise ValueError("shape required")
+        return CSRNDArray(mk(data, dtype), mk(indices, np.int64),
+                          mk(indptr, np.int64), tuple(shape), ctx=ctx)
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else np.asarray(arg1)
+    return cast_storage(_dense_array(src, ctx=ctx, dtype=dtype), "csr")
+
+
+def cast_storage(arr, stype):
+    """ref: src/operator/tensor/cast_storage.cc — dense↔rsp↔csr."""
+    if stype == "default":
+        return arr.todense() if isinstance(arr, BaseSparseNDArray) else arr
+    if isinstance(arr, BaseSparseNDArray):
+        arr = arr.todense()
+    a = arr.asnumpy()
+    if stype == "row_sparse":
+        nz_rows = np.where(np.any(a.reshape(a.shape[0], -1) != 0, axis=1))[0]
+        return RowSparseNDArray(
+            _dense_array(a[nz_rows], ctx=arr._ctx),
+            _dense_array(nz_rows.astype(np.int64), ctx=arr._ctx, dtype=np.int64),
+            a.shape, ctx=arr._ctx)
+    if stype == "csr":
+        if a.ndim != 2:
+            raise ValueError("csr requires 2-D")
+        rows, cols = np.nonzero(a)
+        indptr = np.zeros(a.shape[0] + 1, np.int64)
+        np.add.at(indptr, rows + 1, 1)
+        indptr = np.cumsum(indptr)
+        return CSRNDArray(
+            _dense_array(a[rows, cols], ctx=arr._ctx),
+            _dense_array(cols.astype(np.int64), ctx=arr._ctx, dtype=np.int64),
+            _dense_array(indptr, ctx=arr._ctx, dtype=np.int64),
+            a.shape, ctx=arr._ctx)
+    raise ValueError("unknown stype %r" % stype)
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    """ref: sparse.py zeros"""
+    ctx = ctx or current_context()
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            _dense_array(np.zeros((0,) + tuple(shape[1:]), dtype), ctx=ctx),
+            _dense_array(np.zeros((0,), np.int64), ctx=ctx, dtype=np.int64),
+            tuple(shape), ctx=ctx)
+    if stype == "csr":
+        return CSRNDArray(
+            _dense_array(np.zeros((0,), dtype), ctx=ctx),
+            _dense_array(np.zeros((0,), np.int64), ctx=ctx, dtype=np.int64),
+            _dense_array(np.zeros((shape[0] + 1,), np.int64), ctx=ctx, dtype=np.int64),
+            tuple(shape), ctx=ctx)
+    raise ValueError("unknown stype %r" % stype)
+
+
+def retain(arr, row_ids):
+    """Keep only given rows (ref: src/operator/tensor/sparse_retain.cc)."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise TypeError("retain expects RowSparseNDArray")
+    want = row_ids.asnumpy().astype(np.int64) if isinstance(row_ids, NDArray) else np.asarray(row_ids, np.int64)
+    have = arr.indices.asnumpy()
+    mask = np.isin(have, want)
+    keep = np.where(mask)[0]
+    return RowSparseNDArray(
+        NDArray(arr.data._read()[jnp.asarray(keep, jnp.int32)], ctx=arr._ctx),
+        _dense_array(have[keep], ctx=arr._ctx, dtype=np.int64),
+        arr.shape, ctx=arr._ctx)

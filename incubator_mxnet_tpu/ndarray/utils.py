@@ -1,0 +1,80 @@
+"""NDArray save/load (ref: src/ndarray/ndarray.cc NDArray::Save/Load,
+python/mxnet/ndarray/utils.py save/load).
+
+Format: numpy .npz with a manifest — functionally equivalent to the
+reference's dmlc::Stream binary container (named or unnamed array lists,
+sparse-aware).  Files written by this module round-trip dense and sparse
+arrays with names preserved.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+__all__ = ["save", "load"]
+
+_MAGIC = "mxtpu-ndarray-v1"
+
+
+def save(fname, data):
+    from .ndarray import NDArray
+    from .sparse import RowSparseNDArray, CSRNDArray
+
+    if isinstance(data, NDArray):
+        data = [data]
+    payload = {}
+    manifest = {"magic": _MAGIC, "entries": []}
+    if isinstance(data, dict):
+        items = list(data.items())
+    else:
+        items = [(None, v) for v in data]
+    for i, (name, arr) in enumerate(items):
+        ent = {"name": name, "idx": i}
+        if isinstance(arr, RowSparseNDArray):
+            ent["stype"] = "row_sparse"
+            ent["shape"] = list(arr.shape)
+            payload["a%d_data" % i] = arr.data.asnumpy()
+            payload["a%d_indices" % i] = arr.indices.asnumpy()
+        elif isinstance(arr, CSRNDArray):
+            ent["stype"] = "csr"
+            ent["shape"] = list(arr.shape)
+            payload["a%d_data" % i] = arr.data.asnumpy()
+            payload["a%d_indices" % i] = arr.indices.asnumpy()
+            payload["a%d_indptr" % i] = arr.indptr.asnumpy()
+        else:
+            ent["stype"] = "default"
+            payload["a%d_data" % i] = arr.asnumpy()
+        manifest["entries"].append(ent)
+    payload["__manifest__"] = np.frombuffer(
+        json.dumps(manifest).encode(), dtype=np.uint8)
+    with open(fname, "wb") as f:
+        np.savez(f, **payload)
+
+
+def load(fname):
+    from .ndarray import array
+    from . import sparse
+
+    with np.load(fname) as z:
+        manifest = json.loads(bytes(z["__manifest__"].tobytes()).decode())
+        if manifest.get("magic") != _MAGIC:
+            raise ValueError("not a %s file" % _MAGIC)
+        named = any(e["name"] for e in manifest["entries"])
+        out_list, out_dict = [], {}
+        for e in manifest["entries"]:
+            i = e["idx"]
+            if e["stype"] == "row_sparse":
+                arr = sparse.row_sparse_array(
+                    (z["a%d_data" % i], z["a%d_indices" % i]), shape=tuple(e["shape"]))
+            elif e["stype"] == "csr":
+                arr = sparse.csr_matrix(
+                    (z["a%d_data" % i], z["a%d_indices" % i], z["a%d_indptr" % i]),
+                    shape=tuple(e["shape"]))
+            else:
+                arr = array(z["a%d_data" % i])
+            if named:
+                out_dict[e["name"]] = arr
+            else:
+                out_list.append(arr)
+    return out_dict if named else out_list

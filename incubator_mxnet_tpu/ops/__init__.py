@@ -1,0 +1,18 @@
+"""Operator library: importing this package registers all operators.
+
+Single registry (registry.py) serving eager + symbolic modes — the TPU-native
+analogue of the reference's NNVM registry populated by src/operator/*.cc
+static initializers (SURVEY §2.2).
+"""
+from . import registry
+from .registry import Operator, get_op, list_ops, register, alias
+
+# registration side effects
+from . import math        # noqa: F401  elementwise/broadcast/reduce/dot
+from . import tensor      # noqa: F401  shape/indexing/ordering/sequence
+from . import nn          # noqa: F401  conv/fc/norm/act/pool/loss-outputs
+from . import init_ops    # noqa: F401  zeros/ones/arange/...
+from . import random_ops  # noqa: F401  samplers
+from . import optimizer_ops  # noqa: F401  fused updates
+
+__all__ = ["Operator", "get_op", "list_ops", "register", "alias"]

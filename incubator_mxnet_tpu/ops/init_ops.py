@@ -1,0 +1,60 @@
+"""Creation operators (ref: src/operator/tensor/init_op.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("_zeros", num_inputs=0, differentiable=False, aliases=("zeros",))
+def _zeros(shape=(), dtype="float32"):
+    return jnp.zeros(shape, jnp.dtype(dtype))
+
+
+@register("_ones", num_inputs=0, differentiable=False, aliases=("ones",))
+def _ones(shape=(), dtype="float32"):
+    return jnp.ones(shape, jnp.dtype(dtype))
+
+
+@register("_full", num_inputs=0, differentiable=False, aliases=("full",))
+def _full(shape=(), value=0.0, dtype="float32"):
+    return jnp.full(shape, value, jnp.dtype(dtype))
+
+
+@register("_arange", num_inputs=0, differentiable=False, aliases=("arange",))
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, infer_range=False, dtype="float32"):
+    out = jnp.arange(start, stop, step, jnp.dtype(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_eye", num_inputs=0, differentiable=False, aliases=("eye",))
+def _eye(N=0, M=0, k=0, dtype="float32"):
+    return jnp.eye(int(N), int(M) if M else None, k=int(k), dtype=jnp.dtype(dtype))
+
+
+@register("_linspace", num_inputs=0, differentiable=False, aliases=("linspace",))
+def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32"):
+    return jnp.linspace(start, stop, int(num), endpoint=endpoint, dtype=jnp.dtype(dtype))
+
+
+@register("zeros_like", num_inputs=1, differentiable=False)
+def _zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like", num_inputs=1, differentiable=False)
+def _ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("shape_array", num_inputs=1, differentiable=False)
+def _shape_array(data):
+    """ref: elemwise_unary_op_basic.cc shape_array"""
+    return jnp.asarray(data.shape, dtype=jnp.int64)
+
+
+@register("size_array", num_inputs=1, differentiable=False)
+def _size_array(data):
+    return jnp.asarray([data.size], dtype=jnp.int64)

@@ -1,0 +1,343 @@
+"""Elementwise / broadcast / reduce / linalg operators.
+
+TPU-native equivalents of src/operator/tensor/elemwise_binary_broadcast_op*,
+elemwise_unary_op*, broadcast_reduce_op*, dot*.{cc,cu} (reference, SURVEY
+§2.2).  Every op is a pure jnp/lax function; XLA fuses elementwise chains
+into matmul epilogues (the job MXNet's engine bulking + mshadow expression
+templates did by hand).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+# ---------------------------------------------------------------------------
+# binary broadcast family (reference: elemwise_binary_broadcast_op_basic.cc)
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "broadcast_add": jnp.add,
+    "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+}
+_BINARY_CMP = {
+    "broadcast_equal": jnp.equal,
+    "broadcast_not_equal": jnp.not_equal,
+    "broadcast_greater": jnp.greater,
+    "broadcast_greater_equal": jnp.greater_equal,
+    "broadcast_lesser": jnp.less,
+    "broadcast_lesser_equal": jnp.less_equal,
+    "broadcast_logical_and": jnp.logical_and,
+    "broadcast_logical_or": jnp.logical_or,
+    "broadcast_logical_xor": jnp.logical_xor,
+}
+
+_ALIASES = {
+    "broadcast_add": ("elemwise_add", "_plus", "_add"),
+    "broadcast_sub": ("elemwise_sub", "_minus", "_sub"),
+    "broadcast_mul": ("elemwise_mul", "_mul"),
+    "broadcast_div": ("elemwise_div", "_div"),
+    "broadcast_mod": ("_mod",),
+    "broadcast_power": ("_power", "_pow"),
+    "broadcast_maximum": ("_maximum",),
+    "broadcast_minimum": ("_minimum",),
+    "broadcast_hypot": ("_hypot",),
+    "broadcast_equal": ("_equal",),
+    "broadcast_not_equal": ("_not_equal",),
+    "broadcast_greater": ("_greater",),
+    "broadcast_greater_equal": ("_greater_equal",),
+    "broadcast_lesser": ("_lesser",),
+    "broadcast_lesser_equal": ("_lesser_equal",),
+    "broadcast_logical_and": ("_logical_and",),
+    "broadcast_logical_or": ("_logical_or",),
+    "broadcast_logical_xor": ("_logical_xor",),
+}
+
+
+def _reg_binary(name, fn, differentiable=True, cast=None):
+    def fcompute(lhs, rhs, _fn=fn, _cast=cast):
+        out = _fn(lhs, rhs)
+        if _cast:
+            out = out.astype(lhs.dtype)
+        return out
+    fcompute.__doc__ = "Broadcasting binary op %s (ref: src/operator/tensor/elemwise_binary_broadcast_op*.cc)" % name
+    register(name, num_inputs=2, differentiable=differentiable,
+             aliases=_ALIASES.get(name, ()))(fcompute)
+
+
+for _n, _f in _BINARY.items():
+    _reg_binary(_n, _f)
+for _n, _f in _BINARY_CMP.items():
+    # MXNet comparison ops return same-dtype 0/1 arrays, not bools.
+    _reg_binary(_n, _f, differentiable=False, cast=True)
+
+# ---------------------------------------------------------------------------
+# scalar ops (reference: elemwise_binary_scalar_op_basic.cc)
+# ---------------------------------------------------------------------------
+
+_SCALAR = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: jnp.mod(x, s),
+    "_rmod_scalar": lambda x, s: jnp.mod(s, x),
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpower_scalar": lambda x, s: jnp.power(s, x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)),
+}
+_SCALAR_CMP = {
+    "_equal_scalar": lambda x, s: (x == s),
+    "_not_equal_scalar": lambda x, s: (x != s),
+    "_greater_scalar": lambda x, s: (x > s),
+    "_greater_equal_scalar": lambda x, s: (x >= s),
+    "_lesser_scalar": lambda x, s: (x < s),
+    "_lesser_equal_scalar": lambda x, s: (x <= s),
+}
+
+for _n, _f in _SCALAR.items():
+    def _sc(data, scalar=0.0, _fn=_f):
+        return _fn(data, jnp.asarray(scalar, data.dtype))
+    _sc.__doc__ = "Scalar op %s (ref: elemwise_binary_scalar_op_basic.cc)" % _n
+    register(_n, num_inputs=1)(_sc)
+
+for _n, _f in _SCALAR_CMP.items():
+    def _sc(data, scalar=0.0, _fn=_f):
+        return _fn(data, scalar).astype(data.dtype)
+    _sc.__doc__ = "Scalar comparison %s" % _n
+    register(_n, num_inputs=1, differentiable=False)(_sc)
+
+# ---------------------------------------------------------------------------
+# unary family (reference: elemwise_unary_op_basic.cc, mshadow_op.h)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lax.rsqrt,
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "reciprocal": lambda x: 1.0 / x,
+    "negative": jnp.negative,
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "erf": jax.scipy.special.erf,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+}
+_UNARY_NODIFF = {
+    "sign": jnp.sign,
+    "rint": jnp.rint,
+    "round": jnp.round,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,
+    "logical_not": lambda x: jnp.logical_not(x).astype(x.dtype),
+}
+
+_UNARY_ALIASES = {"negative": ("_np_negative",), "abs": ("_np_abs",)}
+
+for _n, _f in _UNARY.items():
+    def _un(data, _fn=_f):
+        return _fn(data)
+    _un.__doc__ = "Unary op %s (ref: src/operator/tensor/elemwise_unary_op_basic.cc, mshadow_op.h)" % _n
+    register(_n, num_inputs=1, aliases=_UNARY_ALIASES.get(_n, ()))(_un)
+
+for _n, _f in _UNARY_NODIFF.items():
+    def _un(data, _fn=_f):
+        return _fn(data)
+    _un.__doc__ = "Unary (zero-grad) op %s" % _n
+    register(_n, num_inputs=1, differentiable=False)(_un)
+
+
+@register("clip", num_inputs=1)
+def _clip(data, a_min=0.0, a_max=1.0):
+    """Clip values (ref: src/operator/tensor/matrix_op.cc Clip)."""
+    return jnp.clip(data, a_min, a_max)
+
+
+@register("add_n", num_inputs=None, aliases=("ElementWiseSum", "elemwise_sum", "_sum"))
+def _add_n(*args):
+    """Sum of N arrays (ref: src/operator/tensor/elemwise_sum.cc)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+# ---------------------------------------------------------------------------
+# reductions (reference: broadcast_reduce_op_value.cc)
+# ---------------------------------------------------------------------------
+
+
+def _norm_axis(axis, ndim, exclude=False):
+    if axis is None:
+        ax = tuple(range(ndim))
+    elif isinstance(axis, int):
+        ax = (axis % ndim,)
+    else:
+        ax = tuple(a % ndim for a in axis)
+    if exclude:
+        ax = tuple(i for i in range(ndim) if i not in ax)
+    return ax
+
+
+def _reg_reduce(name, jfn, differentiable=True, aliases=()):
+    def fcompute(data, axis=None, keepdims=False, exclude=False, _fn=jfn):
+        ax = _norm_axis(axis, data.ndim, exclude)
+        return _fn(data, axis=ax, keepdims=keepdims)
+    fcompute.__doc__ = "Reduction %s (ref: src/operator/tensor/broadcast_reduce_op_value.cc)" % name
+    register(name, num_inputs=1, differentiable=differentiable, aliases=aliases)(fcompute)
+
+
+_reg_reduce("sum", jnp.sum, aliases=("sum_axis",))
+_reg_reduce("mean", jnp.mean)
+_reg_reduce("prod", jnp.prod)
+_reg_reduce("nansum", jnp.nansum)
+_reg_reduce("nanprod", jnp.nanprod)
+_reg_reduce("max", jnp.max, aliases=("max_axis",))
+_reg_reduce("min", jnp.min, aliases=("min_axis",))
+
+
+@register("norm", num_inputs=1)
+def _norm(data, ord=2, axis=None, keepdims=False):
+    """L2 (or L1) norm (ref: broadcast_reduce_op_value.cc L2Norm)."""
+    ax = None if axis is None else _norm_axis(axis, data.ndim)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims))
+
+
+@register("argmax", num_inputs=1, differentiable=False)
+def _argmax(data, axis=None, keepdims=False):
+    """ref: broadcast_reduce_op_index.cc. Returns float dtype like MXNet."""
+    out = jnp.argmax(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@register("argmin", num_inputs=1, differentiable=False)
+def _argmin(data, axis=None, keepdims=False):
+    out = jnp.argmin(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel", num_inputs=1, differentiable=False)
+def _argmax_channel(data):
+    """argmax over axis 1 (ref: broadcast_reduce_op_index.cc)."""
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+# ---------------------------------------------------------------------------
+# broadcast helpers
+# ---------------------------------------------------------------------------
+
+
+@register("broadcast_to", num_inputs=1)
+def _broadcast_to(data, shape=()):
+    """ref: broadcast_reduce_op_value.cc BroadcastTo (0 = keep dim)."""
+    tgt = tuple(s if s != 0 else d for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_axis", num_inputs=1, aliases=("broadcast_axes",))
+def _broadcast_axis(data, axis=(), size=()):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(data.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+# ---------------------------------------------------------------------------
+# dot / batch_dot (MXU territory; reference: src/operator/tensor/dot-inl.h)
+# ---------------------------------------------------------------------------
+
+
+@register("dot", num_inputs=2)
+def _dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Matrix/tensor product on the MXU (ref: dot-inl.h).
+
+    2-D×2-D → matmul; >2-D follows MXNet: reshape lhs to (-1, last) and rhs
+    to (first, -1).  bf16/f32 inputs hit the systolic array directly.
+    """
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    a2 = a.reshape((-1, a.shape[-1]))
+    b2 = b.reshape((b.shape[0], -1))
+    out = jnp.dot(a2, b2, preferred_element_type=jnp.promote_types(a.dtype, b.dtype))
+    return out.reshape(a.shape[:-1] + b.shape[1:])
+
+
+@register("batch_dot", num_inputs=2)
+def _batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Batched matmul (ref: dot-inl.h BatchDot)."""
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao", num_inputs=None)
+def _khatri_rao(*mats):
+    """Column-wise Khatri-Rao product (ref: src/operator/contrib/krprod.h)."""
+    out = mats[0]
+    for m in mats[1:]:
+        out = jnp.einsum("ik,jk->ijk", out, m).reshape(-1, out.shape[1])
+    return out
+
+
+# mxnet exposes L2 normalization as an op
+@register("L2Normalization", num_inputs=1)
+def _l2norm(data, eps=1e-10, mode="instance"):
+    """ref: src/operator/l2_normalization.cc"""
+    if mode == "instance":
+        ax = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    else:  # spatial
+        ax = tuple(range(2, data.ndim))
+    n = jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=True) + eps)
+    return data / n
+
+
+@register("smooth_l1", num_inputs=1)
+def _smooth_l1(data, scalar=1.0):
+    """ref: src/operator/tensor/elemwise_binary_scalar_op_extended.cc"""
+    s2 = scalar * scalar
+    absd = jnp.abs(data)
+    return jnp.where(absd < 1.0 / s2, 0.5 * s2 * jnp.square(data), absd - 0.5 / s2)

@@ -1,0 +1,401 @@
+"""Core neural-network operators.
+
+TPU-native equivalents of src/operator/nn/ (Convolution, FullyConnected,
+BatchNorm, Pooling, Activation, Dropout, LRN, softmax, LayerNorm, ...) and
+the legacy output/loss ops (softmax_output.cc, regression_output.cc).
+Where the reference dispatches to cuDNN (src/operator/nn/cudnn/), we lower to
+XLA convolutions / reduce_window — the TPU's MXU + fusion pipeline is the
+"cuDNN" here, with autotuning owned by XLA (SURVEY §2.2 cuDNN row).
+
+Layout note: the public API keeps MXNet's NCHW/OIHW conventions; XLA:TPU's
+layout assignment re-tiles internally, so user code ports unchanged.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _pair(v, n=2):
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v,) * n
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected (ref: src/operator/nn/fully_connected.cc)
+# ---------------------------------------------------------------------------
+
+@register("FullyConnected", num_inputs=None)
+def _fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False, flatten=True):
+    """y = x·Wᵀ + b on the MXU (ref: fully_connected.cc:1)."""
+    x = data.reshape((data.shape[0], -1)) if flatten else data
+    out = jnp.dot(x, weight.T, preferred_element_type=jnp.promote_types(x.dtype, weight.dtype))
+    if not no_bias and bias is not None:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution (ref: src/operator/nn/convolution.cc:383-509)
+# ---------------------------------------------------------------------------
+
+@register("Convolution", num_inputs=None)
+def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
+                 num_filter=0, num_group=1, no_bias=False, workspace=1024,
+                 cudnn_tune=None, cudnn_off=False, layout=None):
+    """N-d convolution, NCHW/OIHW (ref: convolution.cc; cuDNN path replaced
+    by XLA's conv which tiles directly onto the MXU)."""
+    nd = len(kernel) if kernel else data.ndim - 2
+    stride = _pair(stride, nd) if stride else (1,) * nd
+    dilate = _pair(dilate, nd) if dilate else (1,) * nd
+    pad = _pair(pad, nd) if pad else (0,) * nd
+    spatial = "".join("DHW"[3 - nd + i] for i in range(nd))
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.promote_types(data.dtype, weight.dtype))
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("Deconvolution", num_inputs=None)
+def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
+                   adj=(), target_shape=(), num_filter=0, num_group=1, no_bias=True,
+                   workspace=512, cudnn_tune=None, cudnn_off=False, layout=None):
+    """Transposed convolution (ref: src/operator/nn/deconvolution.cc).
+
+    Implemented as the gradient of Convolution: lhs-dilated conv with the
+    spatially-flipped kernel — exactly what XLA fuses best.  MXNet deconv
+    weight layout is (in_c, out_c/g, kH, kW) i.e. IOHW.
+    """
+    nd = len(kernel)
+    stride = _pair(stride, nd) if stride else (1,) * nd
+    dilate = _pair(dilate, nd) if dilate else (1,) * nd
+    pad = _pair(pad, nd) if pad else (0,) * nd
+    adj = _pair(adj, nd) if adj else (0,) * nd
+    spatial = "".join("DHW"[3 - nd + i] for i in range(nd))
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NC" + spatial, "IO" + spatial, "NC" + spatial))
+    # effective kernel extent k' = dilate*(k-1)+1; output pad per side:
+    pads = []
+    for i in range(nd):
+        k_eff = dilate[i] * (kernel[i] - 1) + 1
+        lo = k_eff - 1 - pad[i]
+        hi = k_eff - 1 - pad[i] + adj[i]
+        pads.append((lo, hi))
+    out = lax.conv_general_dilated(
+        data, jnp.flip(weight, axis=tuple(range(2, 2 + nd))),
+        window_strides=(1,) * nd,
+        padding=pads,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.promote_types(data.dtype, weight.dtype))
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling (ref: src/operator/nn/pooling.cc, pool.h)
+# ---------------------------------------------------------------------------
+
+@register("Pooling", num_inputs=1, aliases=("Pooling_v1",))
+def _pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad=(),
+             pooling_convention="valid", cudnn_off=False, p_value=2,
+             count_include_pad=True):
+    """max/avg/sum/lp pooling via lax.reduce_window (ref: pooling.cc)."""
+    nd = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    kernel = _pair(kernel, nd)
+    stride = _pair(stride, nd) if stride else (1,) * nd
+    pad = _pair(pad, nd) if pad else (0,) * nd
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    if pooling_convention == "full":
+        # ceil-mode: pad high edge so ceil((x+2p-k)/s)+1 windows fit
+        pads = []
+        for i in range(nd):
+            x = data.shape[2 + i]
+            out_sz = int(np.ceil((x + 2 * pad[i] - kernel[i]) / stride[i])) + 1
+            needed = (out_sz - 1) * stride[i] + kernel[i] - x - pad[i]
+            pads.append((pad[i], max(needed, pad[i])))
+    else:
+        pads = [(p, p) for p in pad]
+    padding = ((0, 0), (0, 0)) + tuple(pads)
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, padding)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            return s / float(np.prod(kernel))
+        ones = jnp.ones(data.shape, data.dtype)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        return s / cnt
+    if pool_type == "lp":
+        s = lax.reduce_window(jnp.abs(data) ** p_value, 0.0, lax.add, window, strides, padding)
+        return s ** (1.0 / p_value)
+    raise ValueError("unknown pool_type %r" % pool_type)
+
+
+@register("UpSampling", num_inputs=None)
+def _upsampling(*args, scale=1, sample_type="nearest", num_args=1, num_filter=0,
+                multi_input_mode="concat", workspace=512):
+    """ref: src/operator/upsampling.cc (nearest + bilinear via XLA resize)."""
+    data = args[0]
+    n, c, h, w = data.shape
+    if sample_type == "nearest":
+        return jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+    return jax.image.resize(data, (n, c, h * scale, w * scale), method="bilinear")
+
+
+# ---------------------------------------------------------------------------
+# Normalization (ref: src/operator/nn/batch_norm.cc, layer_norm.cc, lrn.cc)
+# ---------------------------------------------------------------------------
+
+@register("BatchNorm", num_inputs=5, num_outputs=3, num_visible_outputs=1,
+          takes_is_train=True, nograd_inputs=(3, 4), aliases=("BatchNorm_v1",),
+          fvisible=lambda params, n: n if params.get("output_mean_var") else 1)
+def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
+                fix_gamma=True, use_global_stats=False, output_mean_var=False,
+                axis=1, cudnn_off=False, is_train=False):
+    """ref: batch_norm.cc:89.  Outputs (out, batch_mean, batch_var); the
+    front-end updates the moving_* aux states with `momentum` outside the op,
+    mirroring how the reference mutates aux arrays in-place."""
+    red = tuple(i for i in range(data.ndim) if i != (axis % data.ndim))
+    bshape = tuple(data.shape[axis % data.ndim] if i == axis % data.ndim else 1
+                   for i in range(data.ndim))
+    if is_train and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+    else:
+        mean, var = moving_mean, moving_var
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    inv = lax.rsqrt(var + eps)
+    out = (data - mean.reshape(bshape)) * (g * inv).reshape(bshape) + beta.reshape(bshape)
+    return out, mean, var
+
+
+@register("LayerNorm", num_inputs=3)
+def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    """ref: src/operator/nn/layer_norm.cc"""
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    bshape = [1] * data.ndim
+    bshape[axis] = data.shape[axis]
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("InstanceNorm", num_inputs=3)
+def _instance_norm(data, gamma, beta, eps=1e-3):
+    """ref: src/operator/instance_norm.cc"""
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * lax.rsqrt(var + eps) * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("LRN", num_inputs=1)
+def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response norm across channels (ref: src/operator/nn/lrn.cc)."""
+    sq = jnp.square(data)
+    half = nsize // 2
+    summed = lax.reduce_window(sq, 0.0, lax.add, (1, nsize, 1, 1), (1, 1, 1, 1),
+                               ((0, 0), (half, half), (0, 0), (0, 0)))
+    return data * jnp.power(knorm + (alpha / nsize) * summed, -beta)
+
+
+# ---------------------------------------------------------------------------
+# Activations (ref: src/operator/nn/activation.cc, leaky_relu.cc, softmax.cc)
+# ---------------------------------------------------------------------------
+
+@register("Activation", num_inputs=1)
+def _activation(data, act_type="relu"):
+    """ref: activation.cc"""
+    if act_type == "relu":
+        return jnp.maximum(data, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(data)
+    raise ValueError("unknown act_type %r" % act_type)
+
+
+@register("LeakyReLU", num_inputs=None, needs_rng=True, takes_is_train=True)
+def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125,
+                upper_bound=0.334, rng=None, is_train=False):
+    """ref: src/operator/leaky_relu.cc (leaky/elu/prelu/rrelu)."""
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * jnp.expm1(data))
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) if gamma.ndim == 1 else gamma
+        return jnp.where(data > 0, data, g * data)
+    if act_type == "rrelu":
+        if is_train:
+            s = jax.random.uniform(rng, data.shape, data.dtype, lower_bound, upper_bound)
+        else:
+            s = jnp.asarray((lower_bound + upper_bound) / 2.0, data.dtype)
+        return jnp.where(data > 0, data, s * data)
+    raise ValueError("unknown act_type %r" % act_type)
+
+
+@register("softmax", num_inputs=1)
+def _softmax(data, axis=-1, temperature=None):
+    """ref: src/operator/nn/softmax.cc"""
+    x = data / temperature if temperature else data
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax", num_inputs=1)
+def _log_softmax(data, axis=-1, temperature=None):
+    x = data / temperature if temperature else data
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("SoftmaxActivation", num_inputs=1)
+def _softmax_activation(data, mode="instance"):
+    """ref: src/operator/nn/softmax_activation.cc"""
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+@register("Dropout", num_inputs=1, needs_rng=True, takes_is_train=True)
+def _dropout(data, p=0.5, mode="training", axes=(), rng=None, is_train=False):
+    """Inverted dropout (ref: src/operator/nn/dropout.cc)."""
+    if (not is_train and mode != "always") or p == 0.0:
+        return data
+    shape = data.shape
+    if axes:
+        shape = tuple(1 if i in axes else s for i, s in enumerate(shape))
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng, keep, shape)
+    return jnp.where(mask, data / keep, jnp.zeros((), data.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Legacy output/loss ops with integrated gradients
+# (ref: src/operator/softmax_output.cc, regression_output.cc, svm_output.cc)
+# ---------------------------------------------------------------------------
+
+def _custom_loss_fwd_bwd(fwd_fn, grad_fn):
+    """Build an op whose backward ignores upstream grad, like the reference's
+    *Output ops: backward of SoftmaxOutput is (softmax - onehot(label)) no
+    matter what (softmax_output.cc)."""
+    @jax.custom_vjp
+    def f(data, label):
+        return fwd_fn(data, label)
+
+    def fwd(data, label):
+        return fwd_fn(data, label), (data, label)
+
+    def bwd(res, g):
+        data, label = res
+        return grad_fn(data, label), jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@register("SoftmaxOutput", num_inputs=2, nograd_inputs=(1,), aliases=("Softmax",))
+def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0, multi_output=False,
+                    use_ignore=False, preserve_shape=False, normalization="null",
+                    out_grad=False, smooth_alpha=0.0):
+    """ref: src/operator/softmax_output.cc — fwd softmax, bwd p - onehot(y)."""
+    axis = 1 if (multi_output or preserve_shape or data.ndim > 2) else -1
+
+    def fwd_fn(d, l):
+        return jax.nn.softmax(d, axis=axis)
+
+    def grad_fn(d, l):
+        p = jax.nn.softmax(d, axis=axis)
+        k = d.shape[axis]
+        lab = l.astype(jnp.int32)
+        oh = jax.nn.one_hot(lab, k, dtype=d.dtype, axis=axis)
+        if smooth_alpha:
+            oh = oh * (1 - smooth_alpha) + smooth_alpha / (k - 1) * (1 - oh)
+        g = p - oh
+        if use_ignore:
+            valid = (l != ignore_label).astype(d.dtype)
+            g = g * jnp.expand_dims(valid, axis)
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / d.shape[0]
+        elif normalization == "valid" and use_ignore:
+            nvalid = jnp.maximum(jnp.sum(l != ignore_label), 1).astype(d.dtype)
+            return g * (grad_scale / nvalid)
+        return g * scale
+
+    return _custom_loss_fwd_bwd(fwd_fn, grad_fn)(data, label)
+
+
+@register("LinearRegressionOutput", num_inputs=2, nograd_inputs=(1,))
+def _linear_regression_output(data, label, grad_scale=1.0):
+    """ref: regression_output.cc — fwd identity, bwd (pred - label)."""
+    return _custom_loss_fwd_bwd(
+        lambda d, l: d,
+        lambda d, l: (d - l.reshape(d.shape)) * grad_scale)(data, label)
+
+
+@register("MAERegressionOutput", num_inputs=2, nograd_inputs=(1,))
+def _mae_regression_output(data, label, grad_scale=1.0):
+    return _custom_loss_fwd_bwd(
+        lambda d, l: d,
+        lambda d, l: jnp.sign(d - l.reshape(d.shape)) * grad_scale)(data, label)
+
+
+@register("LogisticRegressionOutput", num_inputs=2, nograd_inputs=(1,))
+def _logistic_regression_output(data, label, grad_scale=1.0):
+    return _custom_loss_fwd_bwd(
+        lambda d, l: jax.nn.sigmoid(d),
+        lambda d, l: (jax.nn.sigmoid(d) - l.reshape(d.shape)) * grad_scale)(data, label)
+
+
+@register("SVMOutput", num_inputs=2, nograd_inputs=(1,))
+def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+                use_linear=False):
+    """ref: src/operator/svm_output.cc"""
+    def grad_fn(d, l):
+        k = d.shape[1]
+        oh = jax.nn.one_hot(l.astype(jnp.int32), k, dtype=d.dtype)
+        if use_linear:
+            viol = ((margin - d) * oh + (margin + d) * (1 - oh)) > 0
+            g = jnp.where(viol, (1 - oh) - oh, 0.0) * regularization_coefficient
+        else:
+            score_y = jnp.sum(d * oh, axis=1, keepdims=True)
+            viol = (d - score_y + margin) > 0
+            g_other = jnp.where(viol & (oh == 0), 2.0 * (d - score_y + margin), 0.0)
+            g = g_other - oh * jnp.sum(g_other, axis=1, keepdims=True)
+            g = g * regularization_coefficient
+        return g.astype(d.dtype)
+
+    return _custom_loss_fwd_bwd(lambda d, l: d, grad_fn)(data, label)

@@ -1,0 +1,162 @@
+"""Fused optimizer-update operators.
+
+In the reference, parameter updates are *operators* (src/operator/
+optimizer_op.cc: sgd_update, sgd_mom_update, adam_update, ...) so they run on
+device inside the engine and on PS servers.  Here each is a pure function
+returning the updated weight (+ updated state tensors); the optimizer layer
+writes results back into the parameter NDArrays.  Under jit (hybridized
+trainer / Module update) the whole update fuses into a handful of XLA
+elementwise kernels — the same reason the reference fused them by hand.
+Multi-precision (mp_*) variants keep a float32 master copy of bf16/fp16
+weights (ref: optimizer_op.cc MP_SGD).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _prep_grad(grad, wd, weight, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+@register("sgd_update", num_inputs=2, differentiable=False, mutate_inputs=(0,))
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    """ref: optimizer_op.cc sgd_update"""
+    g = _prep_grad(grad, wd, weight, rescale_grad, clip_gradient)
+    return weight - lr * g
+
+
+@register("sgd_mom_update", num_inputs=3, differentiable=False, mutate_inputs=(0, 2))
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    """ref: optimizer_op.cc sgd_mom_update: mom = m*mom - lr*g; w += mom"""
+    g = _prep_grad(grad, wd, weight, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+@register("nag_mom_update", num_inputs=3, differentiable=False, mutate_inputs=(0, 2))
+def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    """Nesterov momentum (ref: optimizer.py NAG python updater)."""
+    g = _prep_grad(grad, wd, weight, rescale_grad, clip_gradient)
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+@register("mp_sgd_update", num_inputs=3, differentiable=False, mutate_inputs=(0, 2))
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True):
+    """ref: optimizer_op.cc mp_sgd_update — update in f32, cast to w.dtype."""
+    g32 = _prep_grad(grad.astype(jnp.float32), wd, weight32, rescale_grad, clip_gradient)
+    new_w32 = weight32 - lr * g32
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update", num_inputs=4, differentiable=False, mutate_inputs=(0, 2, 3))
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g32 = _prep_grad(grad.astype(jnp.float32), wd, weight32, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * g32
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("adam_update", num_inputs=4, differentiable=False, mutate_inputs=(0, 2, 3))
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 lazy_update=True):
+    """ref: optimizer_op.cc adam_update (bias correction folded into lr by the
+    Optimizer class, as in python/mxnet/optimizer.py Adam.update)."""
+    g = _prep_grad(grad, wd, weight, rescale_grad, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_w = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return new_w, new_mean, new_var
+
+
+@register("rmsprop_update", num_inputs=3, differentiable=False, mutate_inputs=(0, 2))
+def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    """ref: optimizer_op.cc rmsprop_update"""
+    g = _prep_grad(grad, wd, weight, rescale_grad, clip_gradient)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n
+
+
+@register("rmspropalex_update", num_inputs=5, differentiable=False,
+          mutate_inputs=(0, 2, 3, 4))
+def _rmspropalex_update(weight, grad, n, g, delta, lr=0.001, gamma1=0.95, gamma2=0.9,
+                        epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                        clip_weights=-1.0):
+    """ref: optimizer_op.cc rmspropalex_update (Graves' variant)."""
+    gr = _prep_grad(grad, wd, weight, rescale_grad, clip_gradient)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(gr)
+    new_g = gamma1 * g + (1 - gamma1) * gr
+    new_delta = gamma2 * delta - lr * gr / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    new_w = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n, new_g, new_delta
+
+
+@register("ftrl_update", num_inputs=4, differentiable=False, mutate_inputs=(0, 2, 3))
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0):
+    """ref: optimizer_op.cc ftrl_update"""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1) / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return new_w, new_z, new_n
+
+
+@register("ftml_update", num_inputs=5, differentiable=False, mutate_inputs=(0, 2, 3, 4))
+def _ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
+    """ref: src/operator/optimizer_op.cc ftml_update (FTML, Zheng 2017)."""
+    g = grad * rescale_grad + wd * weight
+    if clip_grad is not None and clip_grad > 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma * weight
+    new_w = -new_z / d_t
+    return new_w, d_t, new_v, new_z
+
+
+@register("signsgd_update", num_inputs=2, differentiable=False, mutate_inputs=(0,))
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """ref: optimizer_op.cc signsgd_update (Bernstein et al.)."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register("signum_update", num_inputs=3, differentiable=False, mutate_inputs=(0, 2))
+def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    """ref: optimizer_op.cc signum_update"""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * (g + wd * weight)
+    new_w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return new_w, new_mom

@@ -1,0 +1,108 @@
+"""Random sampling operators (ref: src/operator/random/sample_op.cc,
+multisample_op.cc, shuffle_op.cc).
+
+The reference maintains per-thread Philox streams (src/common/
+random_generator.h); here every op draws from an explicit JAX PRNG key
+supplied by the dispatch layer (eager: global counter key from
+random_state.py; symbolic: a key threaded through the executor), which is the
+TPU-idiomatic equivalent — deterministic, reproducible, trace-safe.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _dt(dtype):
+    return jnp.dtype(dtype if dtype not in (None, "None") else "float32")
+
+
+@register("_random_uniform", num_inputs=0, differentiable=False, needs_rng=True,
+          aliases=("uniform", "random_uniform"))
+def _uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None, rng=None):
+    return jax.random.uniform(rng, shape, _dt(dtype), low, high)
+
+
+@register("_random_normal", num_inputs=0, differentiable=False, needs_rng=True,
+          aliases=("normal", "random_normal"))
+def _normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None, rng=None):
+    return loc + scale * jax.random.normal(rng, shape, _dt(dtype))
+
+
+@register("_random_gamma", num_inputs=0, differentiable=False, needs_rng=True,
+          aliases=("random_gamma",))
+def _gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None, rng=None):
+    return jax.random.gamma(rng, alpha, shape, _dt(dtype)) * beta
+
+
+@register("_random_exponential", num_inputs=0, differentiable=False, needs_rng=True,
+          aliases=("random_exponential",))
+def _exponential(lam=1.0, shape=(), dtype="float32", ctx=None, rng=None):
+    return jax.random.exponential(rng, shape, _dt(dtype)) / lam
+
+
+@register("_random_poisson", num_inputs=0, differentiable=False, needs_rng=True,
+          aliases=("random_poisson",))
+def _poisson(lam=1.0, shape=(), dtype="float32", ctx=None, rng=None):
+    return jax.random.poisson(rng, lam, shape).astype(_dt(dtype))
+
+
+@register("_random_negative_binomial", num_inputs=0, differentiable=False, needs_rng=True,
+          aliases=("random_negative_binomial",))
+def _negative_binomial(k=1, p=1.0, shape=(), dtype="float32", ctx=None, rng=None):
+    k1, k2 = jax.random.split(rng)
+    lam = jax.random.gamma(k1, k, shape) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, shape).astype(_dt(dtype))
+
+
+@register("_random_generalized_negative_binomial", num_inputs=0, differentiable=False,
+          needs_rng=True, aliases=("random_generalized_negative_binomial",))
+def _gen_negative_binomial(mu=1.0, alpha=1.0, shape=(), dtype="float32", ctx=None, rng=None):
+    k1, k2 = jax.random.split(rng)
+    g = jax.random.gamma(k1, 1.0 / alpha, shape) * (alpha * mu)
+    return jax.random.poisson(k2, g, shape).astype(_dt(dtype))
+
+
+@register("_sample_multinomial", num_inputs=1, differentiable=False, needs_rng=True,
+          aliases=("sample_multinomial",))
+def _multinomial(data, shape=(), get_prob=False, dtype="int32", rng=None):
+    """ref: src/operator/random/multisample_op.cc — sample class ids from
+    probability rows."""
+    n = 1
+    for s in (shape if isinstance(shape, tuple) else (shape,)):
+        n *= int(s) if s else 1
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    if data.ndim == 1:
+        out = jax.random.categorical(rng, logits, shape=(n,))
+        out = out.reshape(shape) if shape else out.reshape(())
+    else:
+        out = jax.random.categorical(rng, logits[:, None, :],
+                                     shape=(data.shape[0], n), axis=-1)
+        out = out.reshape((data.shape[0],) + (tuple(shape) if shape else ()))
+    out = out.astype(jnp.dtype(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(jnp.log(jnp.maximum(data, 1e-30)),
+                                 out.reshape(data.shape[0], -1).astype(jnp.int32)
+                                 if data.ndim > 1 else out.reshape(-1).astype(jnp.int32)[None],
+                                 axis=-1)
+        return out, lp.reshape(out.shape).astype(jnp.float32)
+    return out
+
+
+# per-row distribution sampling (ref: multisample_op.cc _sample_uniform etc.)
+@register("_sample_uniform", num_inputs=2, differentiable=False, needs_rng=True)
+def _sample_uniform(low, high, shape=(), dtype="float32", rng=None):
+    tgt = tuple(low.shape) + (tuple(shape) if shape else ())
+    u = jax.random.uniform(rng, tgt, _dt(dtype))
+    bshape = low.shape + (1,) * (len(tgt) - low.ndim)
+    return low.reshape(bshape) + u * (high - low).reshape(bshape)
+
+
+@register("_sample_normal", num_inputs=2, differentiable=False, needs_rng=True)
+def _sample_normal(mu, sigma, shape=(), dtype="float32", rng=None):
+    tgt = tuple(mu.shape) + (tuple(shape) if shape else ())
+    z = jax.random.normal(rng, tgt, _dt(dtype))
+    bshape = mu.shape + (1,) * (len(tgt) - mu.ndim)
+    return mu.reshape(bshape) + z * sigma.reshape(bshape)

@@ -1,0 +1,163 @@
+"""Single operator registry serving both execution modes.
+
+This is the TPU-native rebirth of the reference's NNVM op registry
+(src/operator/*, NNVM_REGISTER_OP; include/mxnet/op_attr_types.h): ONE
+registration per operator feeds
+
+  * the eager NDArray front-end  (reference: src/imperative/imperative.cc:86)
+  * the autograd tape            (reference: src/imperative/imperative.cc:182)
+  * the symbolic graph executor  (reference: src/executor/graph_executor.cc)
+
+Differences from the reference, by design (SURVEY §7):
+
+  * ``fcompute`` is a pure JAX function — XLA is the kernel library, Pallas
+    the escape hatch — instead of per-device FCompute<cpu|gpu> pairs.
+  * There are no hand-written FInferShape/FInferType attributes: shape and
+    dtype inference is ``jax.eval_shape`` over the same fcompute, so the two
+    can never disagree (reference needed 363 files of paired infer+compute).
+  * There is no FGradient twin-op: gradients come from ``jax.vjp`` over the
+    same fcompute (the tape stores the vjp closure).
+  * Scheduling/async: each eager call dispatches through a cached
+    ``jax.jit``; XLA's async dispatch + donation plays the role of the
+    ThreadedEngine (src/engine/threaded_engine.cc) — ops are issued without
+    blocking Python and dependencies resolve in data-flow order on device.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+
+__all__ = ["Operator", "register", "get_op", "list_ops", "alias"]
+
+_REGISTRY: dict[str, "Operator"] = {}
+
+
+def _hashable(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    return v
+
+
+class Operator:
+    """One registered operator.
+
+    Parameters
+    ----------
+    name : canonical op name (e.g. ``Convolution``, ``broadcast_add``).
+    fcompute : pure function ``(*inputs, **params) -> array | tuple``.
+        If ``needs_rng``, it must accept a keyword ``rng`` (a jax PRNG key).
+        If ``takes_is_train``, it must accept keyword ``is_train`` (static).
+    num_inputs : fixed arity, or ``None`` for variadic (e.g. ``concat``).
+    num_outputs : number of outputs produced by fcompute.
+    num_visible_outputs : outputs exposed to the user (extra outputs are
+        auxiliary, e.g. BatchNorm's batch mean/var); defaults to num_outputs.
+    differentiable : whether vjp should be recorded on the tape.
+    nograd_inputs : indices of inputs that never receive gradient
+        (e.g. integer indices of ``take``).
+    """
+
+    def __init__(self, name: str, fcompute: Callable, *, num_inputs: Optional[int] = 1,
+                 num_outputs: int = 1, num_visible_outputs: Optional[int] = None,
+                 differentiable: bool = True, needs_rng: bool = False,
+                 takes_is_train: bool = False, nograd_inputs=(), mutate_inputs=(),
+                 input_names=None, fvisible=None, doc: str = ""):
+        self.name = name
+        self.fcompute = fcompute
+        self.num_inputs = num_inputs
+        self.num_outputs = num_outputs
+        self.num_visible_outputs = (num_outputs if num_visible_outputs is None
+                                    else num_visible_outputs)
+        self.differentiable = differentiable
+        self.needs_rng = needs_rng
+        self.takes_is_train = takes_is_train
+        self.nograd_inputs = tuple(nograd_inputs)
+        self.mutate_inputs = tuple(mutate_inputs)
+        self.input_names = input_names
+        self.fvisible = fvisible
+        self.doc = doc
+        self._jit_cache: dict = {}
+
+    def visible_outputs(self, params: dict, n_outputs: int) -> int:
+        """How many of ``n_outputs`` are user-visible (rest are aux, e.g.
+        BatchNorm batch stats unless output_mean_var)."""
+        if self.fvisible is not None:
+            return self.fvisible(params, n_outputs)
+        return n_outputs - (self.num_outputs - self.num_visible_outputs)
+
+    # ---- compiled dispatch -------------------------------------------------
+    def bind(self, params: dict, is_train: bool = False):
+        """Return the cached jitted callable for this (params, is_train) combo.
+
+        The returned callable takes the op's array inputs positionally (plus
+        ``rng=`` if needs_rng).  This cache is the analogue of the reference's
+        CachedOp / engine op-bulking: steady-state eager calls are a dict hit
+        + an XLA async dispatch.
+        """
+        key = (_hashable(params), bool(is_train))
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            kw = dict(params)
+            if self.takes_is_train:
+                kw["is_train"] = bool(is_train)
+            raw = functools.partial(self.fcompute, **kw)
+            fn = jax.jit(raw)
+            self._jit_cache[key] = fn
+        return fn
+
+    def raw(self, params: dict, is_train: bool = False):
+        """Un-jitted closure (used when tracing inside an outer jit)."""
+        kw = dict(params)
+        if self.takes_is_train:
+            kw["is_train"] = bool(is_train)
+        return functools.partial(self.fcompute, **kw)
+
+    def infer(self, input_shapes_dtypes, params: dict, is_train: bool = False):
+        """Shape/dtype inference via jax.eval_shape (replaces FInferShape/Type)."""
+        structs = [jax.ShapeDtypeStruct(s, d) for (s, d) in input_shapes_dtypes]
+        fn = self.raw(params, is_train)
+        if self.needs_rng:
+            out = jax.eval_shape(functools.partial(fn, rng=jax.ShapeDtypeStruct((2,), "uint32")), *structs)
+        else:
+            out = jax.eval_shape(fn, *structs)
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        return [(tuple(o.shape), o.dtype) for o in out]
+
+    def __repr__(self):
+        return "Operator(%s)" % self.name
+
+
+def register(name, **kwargs):
+    """Decorator: register ``fcompute`` under ``name`` (+ optional aliases)."""
+    aliases = kwargs.pop("aliases", ())
+
+    def dec(fcompute):
+        op = Operator(name, fcompute, doc=fcompute.__doc__ or "", **kwargs)
+        _REGISTRY[name] = op
+        for a in aliases:
+            _REGISTRY[a] = op
+        return fcompute
+
+    return dec
+
+
+def alias(existing, *names):
+    op = _REGISTRY[existing]
+    for n in names:
+        _REGISTRY[n] = op
+
+
+def get_op(name: str) -> Operator:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError("Operator %r is not registered (have %d ops)"
+                       % (name, len(_REGISTRY))) from None
+
+
+def list_ops():
+    return sorted(_REGISTRY)

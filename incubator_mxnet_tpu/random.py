@@ -1,0 +1,11 @@
+"""mx.random namespace (parity: python/mxnet/random.py)."""
+from __future__ import annotations
+
+from .random_state import seed  # noqa: F401
+from .ndarray.random import (uniform, normal, gamma, exponential, poisson,  # noqa: F401
+                             negative_binomial, generalized_negative_binomial,
+                             multinomial, shuffle)
+
+__all__ = ["seed", "uniform", "normal", "gamma", "exponential", "poisson",
+           "negative_binomial", "generalized_negative_binomial", "multinomial",
+           "shuffle"]

@@ -1,0 +1,36 @@
+"""Global PRNG state for eager random ops.
+
+The reference seeds per-device mshadow/Philox generators via
+``mx.random.seed`` (src/resource.cc:160, src/common/random_generator.h).
+TPU-natively we keep one root ``jax.random`` key and derive a fresh,
+counter-folded subkey per eager random call — deterministic given the seed,
+parallel-safe, and traceable (symbolic executors thread keys explicitly).
+"""
+from __future__ import annotations
+
+import threading
+
+_state = threading.local()
+
+
+def _ensure():
+    if not hasattr(_state, "key"):
+        import jax
+        _state.key = jax.random.PRNGKey(0)
+        _state.count = 0
+
+
+def seed(seed_state):
+    """Parity with mx.random.seed (python/mxnet/random.py)."""
+    import jax
+    _ensure()
+    _state.key = jax.random.PRNGKey(int(seed_state))
+    _state.count = 0
+
+
+def next_key():
+    import jax
+    _ensure()
+    k = jax.random.fold_in(_state.key, _state.count)
+    _state.count += 1
+    return k

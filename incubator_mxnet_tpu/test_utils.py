@@ -1,0 +1,151 @@
+"""Test harness (parity: python/mxnet/test_utils.py — the de-facto op-testing
+toolkit of the reference; SURVEY §4.1).
+
+Key pieces reproduced:
+* ``default_context()`` switched by env so the same suite runs on the CPU
+  mesh and on real TPU (reference: test_utils.py:53-60).
+* ``assert_almost_equal`` with dtype-scaled tolerances (:470).
+* ``rand_ndarray`` incl. sparse densities (:339).
+* ``check_numeric_gradient`` — central finite differences vs autograd
+  (:792), re-based on the tape instead of symbolic executors.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import autograd
+from .context import Context, cpu
+from .ndarray import array, NDArray
+
+
+def default_context():
+    name = os.environ.get("MXTPU_TEST_CTX", os.environ.get("MXNET_TEST_CTX", "cpu"))
+    dev = int(os.environ.get("MXTPU_TEST_DEVICE_ID", "0"))
+    return Context(name, dev)
+
+
+def default_dtype():
+    return np.float32
+
+
+_DTYPE_TOL = {
+    np.dtype(np.float16): (1e-1, 1e-1),
+    np.dtype("bfloat16") if hasattr(np, "bfloat16") else np.dtype(np.float16): (1e-1, 1e-1),
+    np.dtype(np.float32): (1e-3, 1e-4),
+    np.dtype(np.float64): (1e-5, 1e-7),
+}
+
+
+def _tols(a, b, rtol, atol):
+    if rtol is None or atol is None:
+        dt = np.promote_types(a.dtype, b.dtype) if hasattr(a, "dtype") else np.dtype(np.float32)
+        r, t = _DTYPE_TOL.get(np.dtype(dt), (1e-3, 1e-4))
+        return rtol or r, atol or t
+    return rtol, atol
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    """ref: test_utils.py:470"""
+    if isinstance(a, NDArray):
+        a = a.asnumpy()
+    if isinstance(b, NDArray):
+        b = b.asnumpy()
+    a = np.asarray(a)
+    b = np.asarray(b)
+    rtol, atol = _tols(a, b, rtol, atol)
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                               err_msg="%s vs %s mismatch" % names)
+
+
+def same(a, b):
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=ndim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=np.float32, ctx=None,
+                 scale=1.0):
+    """ref: test_utils.py:339"""
+    ctx = ctx or default_context()
+    if stype == "default":
+        return array(np.random.uniform(-scale, scale, shape).astype(dtype), ctx=ctx)
+    from .ndarray import sparse
+    density = 0.3 if density is None else density
+    a = np.random.uniform(-scale, scale, shape).astype(dtype)
+    mask = np.random.rand(*shape) < density
+    a = a * mask
+    if stype == "row_sparse":
+        return sparse.cast_storage(array(a, ctx=ctx), "row_sparse")
+    if stype == "csr":
+        return sparse.cast_storage(array(a, ctx=ctx), "csr")
+    raise ValueError(stype)
+
+
+def numeric_grad(f, inputs, eps=1e-2):
+    """Central finite differences of scalar-valued f w.r.t. each input array."""
+    grads = []
+    base_inputs = [x.asnumpy().astype(np.float64) for x in inputs]
+    for i, x0 in enumerate(base_inputs):
+        g = np.zeros_like(x0)
+        flat = x0.reshape(-1)
+        gflat = g.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            fp = _eval_scalar(f, base_inputs)
+            flat[j] = orig - eps
+            fm = _eval_scalar(f, base_inputs)
+            flat[j] = orig
+            gflat[j] = (fp - fm) / (2 * eps)
+        grads.append(g)
+    return grads
+
+
+def _eval_scalar(f, np_inputs):
+    nds = [array(x.astype(np.float32)) for x in np_inputs]
+    out = f(*nds)
+    return float(out.asnumpy().sum())
+
+
+def check_numeric_gradient(f, inputs, rtol=5e-2, atol=5e-2, eps=1e-2):
+    """ref: test_utils.py:792 — compare tape grads to finite differences.
+
+    ``f``: callable over NDArrays returning one NDArray (summed to scalar).
+    ``inputs``: list of NDArrays.
+    """
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = f(*inputs)
+        s = out.sum()
+    s.backward()
+    analytic = [x.grad.asnumpy() for x in inputs]
+    numeric = numeric_grad(f, inputs, eps=eps)
+    for i, (a, n) in enumerate(zip(analytic, numeric)):
+        np.testing.assert_allclose(a, n, rtol=rtol, atol=atol,
+                                   err_msg="gradient mismatch for input %d" % i)
+
+
+def check_consistency(f, inputs_np, ctxs=None, rtol=None, atol=None):
+    """ref: test_utils.py check_consistency — same computation across
+    contexts (CPU mesh device 0/1, TPU when present) must agree."""
+    from .context import num_devices
+    if ctxs is None:
+        ctxs = [Context("cpu", 0)]
+        if num_devices("cpu") > 1:
+            ctxs.append(Context("cpu", 1))
+    outs = []
+    for ctx in ctxs:
+        nds = [array(x, ctx=ctx) for x in inputs_np]
+        outs.append(f(*nds).asnumpy())
+    for o in outs[1:]:
+        assert_almost_equal(outs[0], o, rtol, atol)
+
+
+def simple_forward(op_fn, *np_inputs, **params):
+    nds = [array(np.asarray(x, np.float32)) for x in np_inputs]
+    return op_fn(*nds, **params).asnumpy()

@@ -1,0 +1,227 @@
+"""NDArray tests (parity: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.test_utils import assert_almost_equal, default_context
+
+
+def test_creation():
+    x = mx.nd.zeros((3, 4))
+    assert x.shape == (3, 4)
+    assert x.dtype == np.float32
+    assert x.size == 12
+    assert_almost_equal(x, np.zeros((3, 4)))
+
+    y = mx.nd.ones((2, 2), dtype="int32")
+    assert y.dtype == np.int32
+    assert_almost_equal(y, np.ones((2, 2)))
+
+    z = mx.nd.full((2, 3), 7.5)
+    assert_almost_equal(z, np.full((2, 3), 7.5))
+
+    a = mx.nd.array([[1, 2], [3, 4]])
+    assert a.dtype == np.float32  # list default float32 like reference
+    assert_almost_equal(a, [[1, 2], [3, 4]])
+
+    r = mx.nd.arange(0, 10, 2)
+    assert_almost_equal(r, np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_elementwise_arith():
+    a_np = np.random.rand(3, 4).astype(np.float32)
+    b_np = np.random.rand(3, 4).astype(np.float32)
+    a, b = mx.nd.array(a_np), mx.nd.array(b_np)
+    assert_almost_equal(a + b, a_np + b_np)
+    assert_almost_equal(a - b, a_np - b_np)
+    assert_almost_equal(a * b, a_np * b_np)
+    assert_almost_equal(a / b, a_np / b_np)
+    assert_almost_equal(a ** 2, a_np ** 2)
+    assert_almost_equal(a + 1, a_np + 1)
+    assert_almost_equal(2 - a, 2 - a_np)
+    assert_almost_equal(2 / a, 2 / a_np)
+    assert_almost_equal(-a, -a_np)
+    assert_almost_equal(abs(-a), np.abs(a_np))
+
+
+def test_inplace_arith():
+    a_np = np.random.rand(3, 4).astype(np.float32)
+    a = mx.nd.array(a_np)
+    a += 1
+    assert_almost_equal(a, a_np + 1)
+    a *= 2
+    assert_almost_equal(a, (a_np + 1) * 2)
+
+
+def test_comparison():
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    b = mx.nd.array([2.0, 2.0, 2.0])
+    assert_almost_equal(a > b, [0, 0, 1])
+    assert_almost_equal(a >= b, [0, 1, 1])
+    assert_almost_equal(a == b, [0, 1, 0])
+    assert_almost_equal(a == 2, [0, 1, 0])
+    # dtype preserved (mxnet returns same-dtype 0/1)
+    assert (a > b).dtype == np.float32
+
+
+def test_broadcast():
+    a = mx.nd.ones((3, 1))
+    b = mx.nd.ones((1, 4)) * 2
+    c = a + b
+    assert c.shape == (3, 4)
+    assert_almost_equal(c, np.full((3, 4), 3.0))
+    d = mx.nd.broadcast_to(mx.nd.array([[1.0], [2.0]]), shape=(2, 3))
+    assert_almost_equal(d, [[1, 1, 1], [2, 2, 2]])
+
+
+def test_indexing_and_views():
+    x = mx.nd.array(np.arange(12).reshape(3, 4))
+    # int index → view (NDArray::At)
+    row = x[1]
+    assert row.shape == (4,)
+    assert_almost_equal(row, [4, 5, 6, 7])
+    # slice → view sharing storage (NDArray::Slice)
+    v = x[1:3]
+    v[:] = 0
+    assert_almost_equal(x, [[0, 1, 2, 3], [0, 0, 0, 0], [0, 0, 0, 0]])
+    # write through int index
+    x[0] = 9
+    assert_almost_equal(x[0], [9, 9, 9, 9])
+    # setitem with array value
+    x[2] = mx.nd.array([1, 2, 3, 4])
+    assert_almost_equal(x[2], [1, 2, 3, 4])
+
+
+def test_reshape_view_semantics():
+    x = mx.nd.array(np.arange(6).reshape(2, 3))
+    r = x.reshape((3, 2))
+    r[0] = -1
+    # write through the reshape view must hit the base (reference: views
+    # share the Chunk, ndarray.h:523)
+    assert_almost_equal(x, [[-1, -1, 2], [3, 4, 5]])
+    # mxnet reshape special codes
+    y = mx.nd.zeros((2, 3, 4))
+    assert y.reshape((-1,)).shape == (24,)
+    assert y.reshape((0, -1)).shape == (2, 12)
+    assert y.reshape((-2,)).shape == (2, 3, 4)
+    assert y.reshape((-3, 0)).shape == (6, 4)
+    assert y.reshape((0, -4, 1, 3, 0)).shape == (2, 1, 3, 4)
+
+
+def test_dtype_cast():
+    x = mx.nd.array([1.5, 2.5])
+    y = x.astype("int32")
+    assert y.dtype == np.int32
+    z = x.astype(np.float16)
+    assert z.dtype == np.float16
+
+
+def test_copy_and_context():
+    x = mx.nd.array([1.0, 2.0])
+    y = x.copy()
+    y += 1
+    assert_almost_equal(x, [1, 2])
+    assert_almost_equal(y, [2, 3])
+    z = mx.nd.zeros((2,))
+    x.copyto(z)
+    assert_almost_equal(z, [1, 2])
+    w = x.as_in_context(mx.cpu(0))
+    assert w.context.device_type == "cpu"
+
+
+def test_scalar_conversion():
+    x = mx.nd.array([3.5])
+    assert x.asscalar() == 3.5
+    assert float(x) == 3.5
+    with pytest.raises(ValueError):
+        mx.nd.array([1.0, 2.0]).asscalar()
+
+
+def test_reductions():
+    a_np = np.random.rand(2, 3, 4).astype(np.float32)
+    a = mx.nd.array(a_np)
+    assert_almost_equal(a.sum(), a_np.sum())
+    assert_almost_equal(a.sum(axis=1), a_np.sum(axis=1))
+    assert_almost_equal(mx.nd.sum(a, axis=(0, 2)), a_np.sum(axis=(0, 2)))
+    assert_almost_equal(mx.nd.mean(a), a_np.mean())
+    assert_almost_equal(mx.nd.max(a, axis=2), a_np.max(axis=2))
+    assert_almost_equal(mx.nd.min(a), a_np.min())
+    assert_almost_equal(mx.nd.norm(a), np.sqrt((a_np ** 2).sum()))
+    # exclude semantics (reference broadcast_reduce_op)
+    assert_almost_equal(mx.nd.sum(a, axis=1, exclude=True), a_np.sum(axis=(0, 2)))
+
+
+def test_dot():
+    a_np = np.random.rand(3, 4).astype(np.float32)
+    b_np = np.random.rand(4, 5).astype(np.float32)
+    assert_almost_equal(mx.nd.dot(mx.nd.array(a_np), mx.nd.array(b_np)),
+                        a_np @ b_np, rtol=1e-4, atol=1e-4)
+    # transpose flags
+    assert_almost_equal(
+        mx.nd.dot(mx.nd.array(a_np), mx.nd.array(b_np.T), transpose_b=True),
+        a_np @ b_np, rtol=1e-4, atol=1e-4)
+    # batch_dot
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    y = np.random.rand(2, 4, 5).astype(np.float32)
+    assert_almost_equal(mx.nd.batch_dot(mx.nd.array(x), mx.nd.array(y)),
+                        np.matmul(x, y), rtol=1e-4, atol=1e-4)
+
+
+def test_concat_split_stack():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.zeros((2, 3))
+    c = mx.nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = mx.nd.split(mx.nd.array(np.arange(12).reshape(2, 6)), num_outputs=3, axis=1)
+    assert len(s) == 3 and s[0].shape == (2, 2)
+    assert_almost_equal(s[1], [[2, 3], [8, 9]])
+    st = mx.nd.stack(a, b, axis=1)
+    assert st.shape == (2, 2, 3)
+
+
+def test_take_onehot():
+    w = mx.nd.array(np.arange(12).reshape(4, 3))
+    idx = mx.nd.array([0, 2])
+    out = mx.nd.take(w, idx)
+    assert_almost_equal(out, [[0, 1, 2], [6, 7, 8]])
+    oh = mx.nd.one_hot(mx.nd.array([1, 0]), depth=3)
+    assert_almost_equal(oh, [[0, 1, 0], [1, 0, 0]])
+    e = mx.nd.Embedding(idx, w, input_dim=4, output_dim=3)
+    assert_almost_equal(e, [[0, 1, 2], [6, 7, 8]])
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrs")
+    a = mx.nd.array(np.random.rand(3, 4))
+    b = mx.nd.array(np.random.rand(5))
+    mx.nd.save(fname, [a, b])
+    loaded = mx.nd.load(fname)
+    assert_almost_equal(loaded[0], a)
+    assert_almost_equal(loaded[1], b)
+    mx.nd.save(fname, {"w": a, "b": b})
+    d = mx.nd.load(fname)
+    assert set(d) == {"w", "b"}
+    assert_almost_equal(d["w"], a)
+
+
+def test_wait_and_iter():
+    x = mx.nd.ones((4, 2))
+    x.wait_to_read()
+    mx.nd.waitall()
+    rows = list(x)
+    assert len(rows) == 4 and rows[0].shape == (2,)
+    assert len(x) == 4
+
+
+def test_random_moments():
+    mx.random.seed(7)
+    u = mx.nd.random.uniform(0, 1, shape=(50000,))
+    assert abs(float(u.mean().asscalar()) - 0.5) < 0.02
+    n = mx.nd.random.normal(2.0, 3.0, shape=(50000,))
+    assert abs(float(n.mean().asscalar()) - 2.0) < 0.1
+    # determinism under seed
+    mx.random.seed(42)
+    a = mx.nd.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(42)
+    b = mx.nd.random.uniform(shape=(5,)).asnumpy()
+    assert np.array_equal(a, b)
