@@ -25,3 +25,10 @@ from .ndarray import NDArray
 from . import autograd
 from . import random
 from . import random_state
+
+from . import lr_scheduler
+from . import optimizer
+from . import optimizer as opt  # alias, as in mxnet
+from . import initializer
+from . import initializer as init  # alias, as in mxnet
+from .initializer import Xavier

@@ -1,0 +1,352 @@
+"""Weight initializers (ref: python/mxnet/initializer.py).
+
+Same registry + ``InitDesc``-driven dispatch as the reference: an
+``Initializer`` is called with a named descriptor and fills the array,
+routing ``_weight``/``_bias``/``_gamma``/``_beta``/``_mean``/``_var`` suffixes
+to the right default fill, honoring ``__init__`` attr overrides, and
+supporting serialization via ``dumps`` (optimizer-to-server parity).
+All randomness flows through the framework PRNG (jax.random keys), not
+global numpy state, so init is reproducible per `mx.random.seed`.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import random_state
+from .ndarray import NDArray
+
+__all__ = ["InitDesc", "Initializer", "Zero", "One", "Constant", "Uniform",
+           "Normal", "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear",
+           "LSTMBias", "Mixed", "Load", "register", "create"]
+
+_INIT_REGISTRY = {}
+
+
+def register(klass):
+    """ref: initializer.py register decorator (mx.init.register)."""
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    return _INIT_REGISTRY[name.lower()](**kwargs)
+
+
+class InitDesc(str):
+    """Name + attrs descriptor (ref: initializer.py class InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer(object):
+    """Base initializer with suffix dispatch (ref: initializer.py:95)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        if print_func is None:
+            def asum_stat(x):
+                return str((np.abs(x.asnumpy()).mean(),))
+            print_func = asum_stat
+        self._print_func = print_func
+        return self
+
+    def _verbose_print(self, desc, init, arr):
+        if self._verbose and self._print_func:
+            logging.info("Initialized %s as %s: %s", desc, init, self._print_func(arr))
+
+    def dumps(self):
+        """ref: initializer.py dumps — json [name, kwargs]."""
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(str(desc))
+        if desc.global_init is None:
+            desc.global_init = self
+        init = desc.attrs.get("__init__", "")
+        if init:
+            klass, kwargs = json.loads(init)
+            create(klass, **kwargs)._init_weight(desc, arr)
+            self._verbose_print(desc, init, arr)
+            return
+        # suffix dispatch, parity with initializer.py __call__
+        if desc.endswith("weight"):
+            self._init_weight(desc, arr)
+            self._verbose_print(desc, "weight", arr)
+        elif desc.endswith("bias"):
+            self._init_bias(desc, arr)
+            self._verbose_print(desc, "bias", arr)
+        elif desc.endswith("gamma"):
+            self._init_gamma(desc, arr)
+            self._verbose_print(desc, "gamma", arr)
+        elif desc.endswith("beta"):
+            self._init_beta(desc, arr)
+            self._verbose_print(desc, "beta", arr)
+        elif desc.endswith("min"):
+            self._init_zero(desc, arr)
+        elif desc.endswith("max"):
+            self._init_one(desc, arr)
+        elif desc.endswith("running_mean") or desc.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif desc.endswith("running_var") or desc.endswith("moving_var"):
+            self._init_one(desc, arr)
+        elif desc.endswith("moving_inv_var"):
+            self._init_zero(desc, arr)
+        elif desc.endswith("moving_avg"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    def _init_bias(self, _, arr):
+        self._fill(arr, jnp.zeros(arr.shape, arr.dtype))
+
+    def _init_gamma(self, _, arr):
+        self._fill(arr, jnp.ones(arr.shape, arr.dtype))
+
+    def _init_beta(self, _, arr):
+        self._fill(arr, jnp.zeros(arr.shape, arr.dtype))
+
+    def _init_zero(self, _, arr):
+        self._fill(arr, jnp.zeros(arr.shape, arr.dtype))
+
+    def _init_one(self, _, arr):
+        self._fill(arr, jnp.ones(arr.shape, arr.dtype))
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError("Must override it")
+
+    def _init_default(self, name, arr):
+        raise ValueError(
+            "Unknown initialization pattern for %s. Default initialization is now "
+            "limited to \"weight\", \"bias\", \"gamma\" (1.0), and \"beta\" (0.0). "
+            "Please use mx.sym.Variable(init=mx.init.*) to set initialization "
+            "pattern" % name)
+
+    @staticmethod
+    def _fill(arr, value):
+        arr._write(jnp.asarray(value, arr._read().dtype))
+
+
+@register
+class Zero(Initializer):
+    """ref: initializer.py class Zero (alias 'zeros')."""
+
+    def _init_weight(self, _, arr):
+        self._fill(arr, jnp.zeros(arr.shape, arr.dtype))
+
+
+@register
+class One(Initializer):
+    """ref: initializer.py class One (alias 'ones')."""
+
+    def _init_weight(self, _, arr):
+        self._fill(arr, jnp.ones(arr.shape, arr.dtype))
+
+
+_INIT_REGISTRY["zeros"] = Zero
+_INIT_REGISTRY["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, _, arr):
+        self._fill(arr, jnp.full(arr.shape, self.value, arr.dtype))
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale) (ref: initializer.py class Uniform)."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        k = random_state.next_key()
+        self._fill(arr, jax.random.uniform(k, arr.shape, jnp.float32,
+                                           -self.scale, self.scale))
+
+
+@register
+class Normal(Initializer):
+    """N(0, sigma) (ref: initializer.py class Normal)."""
+
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        k = random_state.next_key()
+        self._fill(arr, jax.random.normal(k, arr.shape, jnp.float32) * self.sigma)
+
+
+@register
+class Orthogonal(Initializer):
+    """Orthogonal matrix init (ref: initializer.py class Orthogonal)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        k = random_state.next_key()
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(k, (nout, nin), jnp.float32, -1.0, 1.0)
+        else:
+            tmp = jax.random.normal(k, (nout, nin), jnp.float32)
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        self._fill(arr, (self.scale * q).reshape(arr.shape))
+
+
+@register
+class Xavier(Initializer):
+    """Xavier/Glorot (ref: initializer.py class Xavier — rnd_type
+    uniform|gaussian, factor_type avg|in|out)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError("Xavier initializer cannot be applied to vector %s. "
+                             "It requires at least 2D." % name)
+        if len(shape) > 2:
+            hw_scale = np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = 1.0
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("Incorrect factor type")
+        scale = np.sqrt(self.magnitude / factor)
+        k = random_state.next_key()
+        if self.rnd_type == "uniform":
+            self._fill(arr, jax.random.uniform(k, shape, jnp.float32, -scale, scale))
+        elif self.rnd_type == "gaussian":
+            self._fill(arr, jax.random.normal(k, shape, jnp.float32) * scale)
+        else:
+            raise ValueError("Unknown random type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    """Kaiming-He init for PReLU nets (ref: initializer.py class MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__(rnd_type="gaussian", factor_type=factor_type,
+                         magnitude=magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (ref: initializer.py class Bilinear)."""
+
+    def _init_weight(self, _, arr):
+        weight = np.zeros(int(np.prod(arr.shape)), dtype="float32")
+        shape = arr.shape
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(np.prod(shape)):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        self._fill(arr, jnp.asarray(weight.reshape(shape)))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (ref: initializer.py class LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = np.zeros(arr.shape, dtype="float32")
+        num_hidden = int(b.shape[0] / 4)
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        self._fill(arr, jnp.asarray(b))
+
+    # names end in "_bias"; route to the same fill (the reference reaches this
+    # class only via the __init__-attr path, which calls _init_weight directly)
+    _init_bias = _init_weight
+
+
+class Load(object):
+    """Init from a dict of arrays with fallback (ref: initializer.py Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        qualified_param_name = re.compile("^(arg:|aux:)")
+        self.param = {qualified_param_name.sub("", name): arr
+                      for name, arr in param.items()}
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            src = self.param[name]
+            assert tuple(arr.shape) == tuple(src.shape), \
+                "Parameter %s cannot be initialized from loading. " % name + \
+                "Shape mismatch, target %s vs loaded %s" % (str(arr.shape), str(src.shape))
+            arr._write(jnp.asarray(src.asnumpy() if isinstance(src, NDArray) else src))
+            if self.verbose:
+                logging.info("Initialized %s by loading", name)
+        else:
+            assert self.default_init is not None, \
+                "Cannot Initialize %s. Not found in loaded param " % name + \
+                "and no default Initializer is provided."
+            self.default_init(name, arr)
+
+
+class Mixed(object):
+    """Pattern-matched mixture of initializers (ref: initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        assert len(patterns) == len(initializers)
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise ValueError(
+            'Parameter name %s did not match any pattern. Consider adding a '
+            '".*" pattern at the and with default Initializer.' % name)

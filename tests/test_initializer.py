@@ -1,0 +1,103 @@
+"""Initializer tests (parity model: tests/python/unittest/test_init.py)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.initializer import InitDesc
+
+
+def _arr(shape):
+    return mx.nd.empty(shape)
+
+
+def test_constant_and_zero_one():
+    a = _arr((3, 4))
+    mx.init.Constant(2.5)(InitDesc("x_weight"), a)
+    np.testing.assert_allclose(a.asnumpy(), np.full((3, 4), 2.5))
+    mx.init.Zero()(InitDesc("x_weight"), a)
+    assert a.asnumpy().sum() == 0
+    mx.init.One()(InitDesc("x_weight"), a)
+    assert a.asnumpy().sum() == 12
+
+
+def test_suffix_dispatch():
+    init = mx.init.Uniform(0.1)
+    b = _arr((5,))
+    init(InitDesc("fc_bias"), b)
+    assert b.asnumpy().sum() == 0
+    g = _arr((5,))
+    init(InitDesc("bn_gamma"), g)
+    np.testing.assert_allclose(g.asnumpy(), np.ones(5))
+    mv = _arr((5,))
+    init(InitDesc("bn_moving_var"), mv)
+    np.testing.assert_allclose(mv.asnumpy(), np.ones(5))
+    mm = _arr((5,))
+    init(InitDesc("bn_moving_mean"), mm)
+    assert mm.asnumpy().sum() == 0
+
+
+def test_xavier_scale():
+    a = _arr((128, 256))
+    mx.init.Xavier(rnd_type="uniform", factor_type="avg", magnitude=3)(
+        InitDesc("w_weight"), a)
+    v = a.asnumpy()
+    bound = np.sqrt(3.0 / ((128 + 256) / 2))
+    assert np.abs(v).max() <= bound + 1e-6
+    assert v.std() > 0.01
+
+
+def test_uniform_normal_ranges():
+    a = _arr((1000,))
+    mx.init.Uniform(0.5)(InitDesc("u_weight"), a)
+    assert np.abs(a.asnumpy()).max() <= 0.5
+    mx.init.Normal(2.0)(InitDesc("n_weight"), a)
+    assert 1.5 < a.asnumpy().std() < 2.5
+
+
+def test_orthogonal():
+    a = _arr((16, 16))
+    mx.init.Orthogonal(scale=1.0)(InitDesc("o_weight"), a)
+    q = a.asnumpy()
+    np.testing.assert_allclose(q @ q.T, np.eye(16), atol=1e-4)
+
+
+def test_attr_override_via_init_desc():
+    a = _arr((4, 4))
+    desc = InitDesc("custom_weight", attrs={"__init__": '["constant", {"value": 7.0}]'})
+    mx.init.Uniform()(desc, a)
+    np.testing.assert_allclose(a.asnumpy(), np.full((4, 4), 7.0))
+
+
+def test_mixed_and_load():
+    a = _arr((2, 2))
+    mixed = mx.init.Mixed([".*bias", ".*"],
+                          [mx.init.Zero(), mx.init.Constant(3.0)])
+    mixed("conv_bias", a)
+    assert a.asnumpy().sum() == 0
+    mixed("conv_weight", a)
+    np.testing.assert_allclose(a.asnumpy(), np.full((2, 2), 3.0))
+
+    saved = {"p_weight": mx.nd.array(np.arange(4.0).reshape(2, 2))}
+    load = mx.init.Load(saved, default_init=mx.init.Zero())
+    b = _arr((2, 2))
+    load("p_weight", b)
+    np.testing.assert_allclose(b.asnumpy(), np.arange(4.0).reshape(2, 2))
+    c = _arr((2, 2))
+    load("q_weight", c)
+    assert c.asnumpy().sum() == 0
+
+
+def test_lstm_bias():
+    a = _arr((8,))
+    mx.init.LSTMBias(forget_bias=1.0)(InitDesc("l0_bias"), a)
+    v = a.asnumpy()
+    np.testing.assert_allclose(v[2:4], np.ones(2))
+    assert v[:2].sum() == 0 and v[4:].sum() == 0
+
+
+def test_dumps_create_roundtrip():
+    import json
+    blob = mx.init.Xavier(magnitude=2.0).dumps()
+    name, kwargs = json.loads(blob)
+    init2 = mx.init.create(name, **kwargs)
+    assert isinstance(init2, mx.init.Xavier)
+    assert init2.magnitude == 2.0

@@ -1,0 +1,136 @@
+"""Optimizer correctness vs numpy reference updaters.
+
+Parity model: tests/python/unittest/test_optimizer.py compares each fused
+update op against a pure-python reference updater.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+
+def _setup(shape=(4, 7), seed=0):
+    rs = np.random.RandomState(seed)
+    w = rs.randn(*shape).astype(np.float32)
+    g = rs.randn(*shape).astype(np.float32)
+    return w, g
+
+
+def _run_updates(opt, w_np, g_np, n=3):
+    w = mx.nd.array(w_np)
+    updater = mx.optimizer.get_updater(opt)
+    for _ in range(n):
+        updater(0, mx.nd.array(g_np), w)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    w_np, g_np = _setup()
+    lr, wd = 0.1, 0.01
+    got = _run_updates(mx.optimizer.SGD(learning_rate=lr, wd=wd), w_np, g_np)
+    ref = w_np.copy()
+    for _ in range(3):
+        ref = ref - lr * (g_np + wd * ref)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_momentum_matches_numpy():
+    w_np, g_np = _setup()
+    lr, mom, wd = 0.1, 0.9, 0.0
+    got = _run_updates(mx.optimizer.SGD(learning_rate=lr, momentum=mom, wd=wd),
+                       w_np, g_np)
+    ref, m = w_np.copy(), np.zeros_like(w_np)
+    for _ in range(3):
+        m = mom * m - lr * g_np
+        ref = ref + m
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_matches_numpy():
+    w_np, g_np = _setup()
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    got = _run_updates(mx.optimizer.Adam(learning_rate=lr, beta1=b1, beta2=b2,
+                                         epsilon=eps), w_np, g_np)
+    ref = w_np.copy()
+    mean = np.zeros_like(w_np)
+    var = np.zeros_like(w_np)
+    for t in range(1, 4):
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        mean = b1 * mean + (1 - b1) * g_np
+        var = b2 * var + (1 - b2) * g_np ** 2
+        ref = ref - lr_t * mean / (np.sqrt(var) + eps)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_rmsprop_runs_and_descends():
+    w_np = np.array([[2.0, -3.0]], dtype=np.float32)
+    w = mx.nd.array(w_np)
+    opt = mx.optimizer.RMSProp(learning_rate=0.1)
+    updater = mx.optimizer.get_updater(opt)
+    # gradient of 0.5*w^2 is w: repeated updates shrink |w|
+    for _ in range(20):
+        updater(0, w.copy(), w)
+    assert np.abs(w.asnumpy()).sum() < np.abs(w_np).sum()
+
+
+@pytest.mark.parametrize("name", ["sgd", "adam", "nag", "rmsprop", "adagrad",
+                                  "adadelta", "ftrl", "ftml", "signum", "sgld",
+                                  "dcasgd", "lbsgd", "test"])
+def test_create_registry_and_update(name):
+    opt = mx.optimizer.create(name)
+    w = mx.nd.array(np.ones((3,), np.float32))
+    g = mx.nd.array(np.full((3,), 0.5, np.float32))
+    updater = mx.optimizer.get_updater(opt)
+    updater(0, g, w)
+    assert w.shape == (3,)
+    assert np.all(np.isfinite(w.asnumpy()))
+
+
+def test_multi_precision_sgd():
+    w = mx.nd.array(np.ones((4,), np.float32)).astype("bfloat16")
+    g = mx.nd.array(np.full((4,), 0.25, np.float32)).astype("bfloat16")
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True)
+    updater = mx.optimizer.get_updater(opt)
+    for _ in range(2):
+        updater(0, g, w)
+    assert w.dtype == np.dtype("bfloat16")
+    # m1 = -0.025; w1 = 0.975 ; m2 = 0.9*m1 - 0.025 = -0.0475; w2 = 0.9275
+    np.testing.assert_allclose(w.astype("float32").asnumpy(),
+                               np.full((4,), 0.9275), rtol=2e-2)
+
+
+def test_updater_states_roundtrip():
+    opt = mx.optimizer.Adam()
+    updater = mx.optimizer.get_updater(opt)
+    w = mx.nd.array(np.ones((3,), np.float32))
+    updater(0, mx.nd.array(np.ones((3,), np.float32)), w)
+    blob = updater.get_states(dump_optimizer=True)
+    u2 = mx.optimizer.get_updater(mx.optimizer.Adam())
+    u2.set_states(blob)
+    assert 0 in u2.states
+
+
+def test_lr_schedulers():
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 1.0
+    assert s(1) == 1.0
+    assert s(11) == 0.5
+    m = mx.lr_scheduler.MultiFactorScheduler(step=[5, 10], factor=0.1)
+    m.base_lr = 1.0
+    assert m(1) == 1.0
+    assert abs(m(6) - 0.1) < 1e-12
+    assert abs(m(11) - 0.01) < 1e-12
+    p = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, pwr=2)
+    assert p(0) == 1.0
+    assert p(100) == 0.0
+
+
+def test_lr_wd_mult():
+    opt = mx.optimizer.SGD(learning_rate=1.0, param_idx2name={0: "fc_weight",
+                                                              1: "fc_bias"})
+    opt.set_lr_mult({"fc_weight": 0.5})
+    opt.set_wd_mult({})
+    assert opt._get_lr(0) == 0.5
+    assert opt._get_lr(1) == 1.0
+    # bias gets wd_mult 0 by the reference heuristic
+    assert opt._get_wd(1) == 0.0
