@@ -32,3 +32,12 @@ from . import optimizer as opt  # alias, as in mxnet
 from . import initializer
 from . import initializer as init  # alias, as in mxnet
 from .initializer import Xavier
+
+from . import name
+from . import kvstore
+from . import kvstore as kv  # alias, as in mxnet
+from . import io
+from . import recordio
+from . import image
+from . import gluon
+from . import parallel

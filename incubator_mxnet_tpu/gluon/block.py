@@ -1,0 +1,577 @@
+"""Gluon Block / HybridBlock: define-by-run layers with jit hybridization.
+
+TPU-native rebirth of python/mxnet/gluon/block.py:
+
+* ``Block`` (block.py:123) — imperative container with auto-registered
+  children and Parameters, prefix scoping via ``_BlockScope``.
+* ``HybridBlock`` (block.py:376) — on ``hybridize()``, the forward is traced
+  ONCE per input signature into a **CachedOp = jax.jit of the functionalized
+  forward** (block.py:436-439 traces to a symbolic CachedOp; here XLA is the
+  graph executor, so tracing and compiling are the same step).  The
+  functionalization:
+    - parameters enter as pytree leaves (so donation/sharding apply),
+    - the framework PRNG is threaded in as an explicit key,
+    - in-place parameter writes during the trace (BatchNorm moving stats)
+      are detected via the NDArray version counter and returned as extra
+      outputs, then written back eagerly — MXNet's mutable aux-state
+      semantics preserved over functional XLA.
+* Under autograd recording, one tape node is recorded for the whole
+  CachedOp with its jax.vjp — mirroring ``_CachedOp``'s fused backward
+  (src/imperative/cached_op.cc:434).
+"""
+from __future__ import annotations
+
+import copy
+import threading
+
+import numpy as np
+import jax
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray import NDArray
+from .. import ndarray as _nd
+from ..ops.registry import Operator
+from .. import autograd
+from .. import random_state
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _BlockScope(object):
+    """Name/prefix scope for Blocks (ref: block.py class _BlockScope)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        """Create prefix+params pair for the new Block."""
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                from ..name import NameManager
+                prefix = NameManager.current().get(None, hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = "%s%d_" % (hint, count)
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        from ..name import Prefix
+        self._name_scope = Prefix(self._block.prefix)
+        self._name_scope.__enter__()
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        self._name_scope.__exit__(ptype, value, trace)
+        self._name_scope = None
+        _BlockScope._current.value = self._old_scope
+
+
+def _flatten(args, inout_str):
+    """Flatten nested list/tuple of NDArrays (ref: block.py _flatten)."""
+    if isinstance(args, NDArray):
+        return [args], int(0)
+    if args is None:
+        return [None], int(-1)
+    assert isinstance(args, (list, tuple)), \
+        "HybridBlock %s must be (nested) list of NDArray, but got %s of type %s" \
+        % (inout_str, str(args), str(type(args)))
+    flat = []
+    fmts = []
+    for i in args:
+        arg, fmt = _flatten(i, inout_str)
+        flat.extend(arg)
+        fmts.append(fmt)
+    return flat, fmts
+
+
+def _regroup(args, fmt):
+    """Inverse of _flatten (ref: block.py _regroup)."""
+    if isinstance(fmt, int):
+        if fmt == -1:
+            return None, args[1:]
+        if fmt == 0:
+            return args[0], args[1:]
+        return args[:fmt], args[fmt:]
+    ret = []
+    for i in fmt:
+        res, args = _regroup(args, i)
+        ret.append(res)
+    return ret, args
+
+
+class Block(object):
+    """Base class for all neural network layers (ref: block.py:123)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = []
+        self._reg_params = {}
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join("  ({key}): {block}".format(
+            key=key, block=_indent(str(block), 2))
+            for key, block in self.__dict__.items()
+            if isinstance(block, Block))
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        """Auto-register children and params (ref: block.py __setattr__)."""
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and not isinstance(value, type(existing)):
+                raise TypeError("Changing attribute type for {name} from {type1} to {type2}"
+                                "is not allowed.".format(name=name,
+                                                         type1=type(existing),
+                                                         type2=type(value)))
+            if isinstance(existing, Block):
+                for i, c in enumerate(self._children):
+                    if c is existing:
+                        self._children[i] = value
+            elif isinstance(value, Block):
+                self.register_child(value)
+        elif isinstance(value, Block):
+            self.register_child(value)
+        if isinstance(value, Parameter):
+            assert name not in self._reg_params or self._reg_params[name] is value, \
+                "Overriding Parameter attribute %s is not allowed. " \
+                "If you want to share parameters between blocks, please set " \
+                "'params' at Block construction instead."
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        """ref: block.py name_scope."""
+        return self._scope
+
+    @property
+    def params(self):
+        """ParameterDict of this Block only (not children)."""
+        return self._params
+
+    def collect_params(self, select=None):
+        """Recursively collect Parameters (ref: block.py collect_params,
+        with the 1.3+ `select` regex for forward-compat)."""
+        import re
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for cld in self._children:
+            ret.update(cld.collect_params(select=select))
+        return ret
+
+    def save_params(self, filename):
+        """ref: block.py:295 save_params."""
+        self.collect_params().save(filename, strip_prefix=self.prefix)
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        """ref: block.py:303 load_params."""
+        self.collect_params().load(filename, ctx, allow_missing, ignore_extra,
+                                   self.prefix)
+
+    def register_child(self, block):
+        """ref: block.py register_child."""
+        self._children.append(block)
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        """ref: block.py initialize."""
+        from .. import initializer
+        if init is None:
+            init = initializer.Uniform()
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        """Recursively activate hybridization on HybridBlock children."""
+        for cld in self._children:
+            cld.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        """ref: block.py cast."""
+        for child in self._children:
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+
+def _indent(s_, num_spaces):
+    lines = s_.split("\n")
+    first = lines.pop(0)
+    lines = [(num_spaces * " ") + line for line in lines]
+    return "\n".join([first] + lines)
+
+
+class _TraceParam(object):
+    """Shadow for a Parameter during CachedOp tracing: .data() returns the
+    tracer-backed NDArray; writes land on the shadow and are harvested."""
+
+    __slots__ = ("param", "shadow")
+
+    def __init__(self, param, shadow):
+        self.param = param
+        self.shadow = shadow
+
+
+class CachedOp(object):
+    """jit-compiled trace of a HybridBlock forward.
+
+    The TPU-native _CachedOp (ref: src/imperative/cached_op.cc): cache key is
+    (input shapes/dtypes, train flag) — the reference's static-shape
+    specialization (cached_op.cc:179 GetForwardGraph keyed on shapes) becomes
+    XLA's compile cache. Bucketed shapes therefore each compile once and hit
+    thereafter, which is how BucketingModule-style workloads stay fast.
+    """
+
+    def __init__(self, block):
+        self.block = block
+        self._cache = {}
+
+    def _make_fn(self, param_names, n_inputs, in_fmt, train):
+        block = self.block
+
+        def fn(param_vals, input_vals, rng):
+            shadows = {}
+            params = block._active_params
+            for name in param_names:
+                p = params[name]
+                shadows[name] = NDArray(param_vals[name])
+            nd_in = [None if v is None else NDArray(v) for v in input_vals]
+            args, _ = _regroup(nd_in, in_fmt)
+            if not isinstance(args, list):
+                args = [args]
+            with random_state.use_key(rng):
+                with autograd._scope(recording=False, training=train):
+                    with block._trace_params(shadows):
+                        out = block.hybrid_forward_dispatch(*args)
+            flat_out, out_fmt = _flatten(out, "output")
+            out_vals = tuple(o._read() for o in flat_out)
+            # harvest in-place writes to parameters (aux states): shadow
+            # version counter moved ⇒ the trace mutated it
+            aux_updates = {name: sh._read() for name, sh in shadows.items()
+                           if sh._version > 0}
+            self._last_out_fmt = out_fmt
+            return out_vals, aux_updates
+
+        return fn
+
+    def __call__(self, *args):
+        block = self.block
+        flat_args, in_fmt = _flatten(args, "input")
+        params = block._active_params
+        param_names = sorted(params.keys())
+        param_vals = {}
+        for name in param_names:
+            p = params[name]
+            if p._data is None:
+                if not p._deferred_init or p.shape is None or \
+                        0 in p.shape or np.prod(p.shape) <= 0:
+                    # unresolved deferred shape (or not initialized): p.data()
+                    # raises the right error; forward() catches Deferred and
+                    # runs the eager shape-inference pass first
+                    p.data()
+                p._finish_deferred_init()
+            param_vals[name] = p.data()._read()
+        input_vals = [None if a is None else a._read() for a in flat_args]
+        train = autograd.is_training()
+        recording = autograd.is_recording()
+
+        key = (tuple(None if v is None else (v.shape, str(v.dtype))
+                     for v in input_vals),
+               tuple((param_vals[n].shape, str(param_vals[n].dtype))
+                     for n in param_names),
+               _fmt_key(in_fmt), train)
+        entry = self._cache.get(key)
+        if entry is None:
+            raw = self._make_fn(param_names, len(input_vals), in_fmt, train)
+
+            def vjp_apply(pv, iv, rng_, cts):
+                # forward rematerializes inside the compiled backward — the
+                # whole fwd+bwd is one XLA program, no Python re-trace per
+                # step (rng_ is the same key, so dropout masks match)
+                _, vjp_fn = jax.vjp(lambda p, i: raw(p, i, rng_)[0], pv, iv)
+                return vjp_fn(cts)
+
+            entry = {"raw": raw, "jit": jax.jit(raw), "vjp": jax.jit(vjp_apply)}
+            self._cache[key] = entry
+
+        rng = random_state.next_key()
+        out_vals, aux_updates = entry["jit"](param_vals, input_vals, rng)
+        if "out_fmt" not in entry:
+            # fn ran (traced) at least once for this entry, setting the fmt
+            entry["out_fmt"] = self._last_out_fmt
+
+        ctx = flat_args[0]._ctx if flat_args else current_context()
+        out_arrays = [NDArray(v, ctx=ctx) for v in out_vals]
+
+        # write back mutated aux states (moving mean/var)
+        for name, val in aux_updates.items():
+            params[name].data()._write(val)
+
+        if recording:
+            real_idx = [i for i, a in enumerate(flat_args) if a is not None]
+            tape_inputs = [params[n].data() for n in param_names] + \
+                [flat_args[i] for i in real_idx]
+
+            def tape_vjp(ct):
+                cts = ct if isinstance(ct, tuple) else (ct,)
+                pv_g, iv_g = entry["vjp"](param_vals, input_vals, rng, cts)
+                return tuple(pv_g[n] for n in param_names) + \
+                    tuple(iv_g[i] for i in real_idx)
+
+            op = Operator("_CachedOp", lambda *a: a,
+                          num_inputs=len(tape_inputs),
+                          num_outputs=len(out_arrays))
+            autograd._record(op, tape_inputs, out_arrays, tape_vjp)
+
+        out, _ = _regroup(out_arrays, entry["out_fmt"])
+        return out
+
+
+def _fmt_key(fmt):
+    if isinstance(fmt, list):
+        return tuple(_fmt_key(f) for f in fmt)
+    return fmt
+
+
+class HybridBlock(Block):
+    """Block that can be traced+compiled (ref: block.py:376 HybridBlock).
+
+    Subclasses implement ``hybrid_forward(F, x, *, weight=..., ...)``; F is
+    the ndarray module eagerly and (conceptually) the symbol module under
+    tracing — with XLA, both paths run the same jax ops, so F is always the
+    ndarray module and tracing happens at the jax level.
+    """
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op = None
+        self._trace_shadows = None
+
+    @property
+    def _active_params(self):
+        """name → Parameter used by this block subtree's forward."""
+        out = {}
+        for name, p in self.collect_params().items():
+            out[name] = p
+        return out
+
+    def __setattr__(self, name, value):
+        super().__setattr__(name, value)
+        if isinstance(value, HybridBlock):
+            self._clear_cached_op()
+
+    def _clear_cached_op(self):
+        self._cached_op = None
+
+    def register_child(self, block):
+        if not isinstance(block, HybridBlock):
+            raise ValueError(
+                "Children of HybridBlock must also be HybridBlock, "
+                "but %s has type %s. If you are using Sequential, "
+                "please try HybridSequential instead." % (
+                    str(block), str(type(block))))
+        super().register_child(block)
+        self._clear_cached_op()
+
+    def hybridize(self, active=True, **kwargs):
+        """ref: block.py hybridize — subsequent calls compile & cache."""
+        self._active = active
+        self._clear_cached_op()
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Deferred-shape resolution by a dry trace (ref: block.py infer_shape)."""
+        self._deferred_infer_shape(*args)
+
+    def _deferred_infer_shape(self, *args):
+        """Run shape inference via jax.eval_shape over the eager forward to
+        fill deferred parameter shapes (ref: block.py _deferred_infer_shape
+        which re-infers through the symbolic graph)."""
+        try:
+            self.forward_eager_infer(*args)
+        except DeferredInitializationError:
+            raise
+        except Exception as e:
+            raise ValueError("Deferred initialization failed because shape "
+                             "cannot be inferred: %s" % e)
+
+    def forward_eager_infer(self, *args):
+        # default: child blocks implement shape hints via their own
+        # hybrid_forward's deferred logic (each layer fills in its params)
+        pass
+
+    # dispatch helper used by both eager and traced paths
+    def hybrid_forward_dispatch(self, *args):
+        params = {}
+        shadows = self._trace_shadows
+        deferred = [p for p in self._reg_params.values()
+                    if p._data is None and p._deferred_init]
+        if deferred and (shadows is None or
+                         any(p.name not in shadows for p in deferred)):
+            # layer-local shape inference from the live input (the reference
+            # resolves deferred shapes via symbolic infer_shape,
+            # block.py _deferred_infer_shape; here each layer fills its own)
+            self._pre_infer(*args)
+            for p in deferred:
+                p._finish_deferred_init()
+        for name, p in self._reg_params.items():
+            if shadows is not None and p.name in shadows:
+                params[name] = shadows[p.name]
+            else:
+                params[name] = p.data()
+        from .. import ndarray as F
+        return self.hybrid_forward(F, *args, **params)
+
+    def _pre_infer(self, *args):
+        """Fill deferred parameter shapes from the first input. Layers with
+        in_units/in_channels==0 override this."""
+        return
+
+    from contextlib import contextmanager
+
+    @contextmanager
+    def _trace_params(self, shadows):
+        """Install shadow tracer NDArrays for all params in the subtree."""
+        stack = [self]
+        blocks = []
+        while stack:
+            b = stack.pop()
+            blocks.append(b)
+            stack.extend(b._children)
+        prev = [getattr(b, "_trace_shadows", None) for b in blocks]
+        for b in blocks:
+            b._trace_shadows = shadows
+        try:
+            yield
+        finally:
+            for b, p in zip(blocks, prev):
+                b._trace_shadows = p
+
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, x, *args):
+        """Defines the forward computation (ref: block.py:561 forward)."""
+        if self._trace_shadows is not None:
+            # inside an enclosing CachedOp trace: inline into the parent's
+            # single jit (the reference inlines subgraphs too, cached_op.cc:69)
+            return self.hybrid_forward_dispatch(x, *args)
+        if self._active:
+            if self._cached_op is None:
+                self._cached_op = CachedOp(self)
+            try:
+                return self._cached_op(x, *args)
+            except DeferredInitializationError:
+                self._run_deferred_init(x, *args)
+                return self._cached_op(x, *args)
+        try:
+            return self.hybrid_forward_dispatch(x, *args)
+        except DeferredInitializationError:
+            self._run_deferred_init(x, *args)
+            return self.hybrid_forward_dispatch(x, *args)
+
+    def _run_deferred_init(self, *args):
+        """First-call shape resolution: one eager pass lets every layer in
+        the subtree fill its own deferred parameter shapes."""
+        with autograd.pause():
+            self.hybrid_forward_dispatch(*args)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        """Override to define the computation (ref: block.py hybrid_forward)."""
+        raise NotImplementedError
+
+
+class SymbolBlock(HybridBlock):
+    """Build a HybridBlock from a Symbol (ref: block.py:599 SymbolBlock).
+
+    Constructed lazily: the symbol executor lives in the symbol module
+    (phase 5); SymbolBlock wraps its traced callable.
+    """
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=params)
+        from ..symbol import Symbol
+        if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
+            outputs = outputs[0]
+        if not isinstance(outputs, Symbol):
+            raise TypeError("outputs must be a Symbol")
+        syms = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self._in_names = [s.name for s in syms]
+        self._sym = outputs
+        # register all non-input arguments as parameters
+        arg_names = [n for n in outputs.list_arguments() if n not in self._in_names]
+        aux_names = list(outputs.list_auxiliary_states())
+        for n in arg_names:
+            self.params.get(n.removeprefix(self.params.prefix) if n.startswith(self.params.prefix) else n,
+                            allow_deferred_init=True, grad_req="write")
+        for n in aux_names:
+            self.params.get(n.removeprefix(self.params.prefix) if n.startswith(self.params.prefix) else n,
+                            allow_deferred_init=True, grad_req="null")
+
+    def forward(self, *args):
+        in_map = dict(zip(self._in_names, args))
+        param_map = {}
+        for name, p in self.params.items():
+            short = name[len(self.params.prefix):] if name.startswith(self.params.prefix) else name
+            param_map[short] = p.data()
+        merged = dict(param_map)
+        merged.update(in_map)
+        return self._sym.eval_dict(merged)
+
+    def hybrid_forward(self, F, *args, **kwargs):  # pragma: no cover
+        raise NotImplementedError

@@ -1,0 +1,92 @@
+"""DataLoader (ref: python/mxnet/gluon/data/dataloader.py).
+
+The reference forks worker processes and ships batches through POSIX
+shared-memory NDArrays (dataloader.py:72 rebuild_ndarray).  The TPU-native
+pipeline keeps augmentation on host CPU in a thread pool — numpy transforms
+release the GIL, jax.device_put overlaps H2D with compute — and hands the
+device exactly one ready batch ahead (double-buffering, the same effect the
+reference's prefetcher iterators achieve: src/io/iter_prefetcher.h).
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ...ndarray import NDArray
+from ... import ndarray as _nd
+from .sampler import BatchSampler, RandomSampler, SequentialSampler, Sampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (ref: dataloader.py default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return _nd.ndarray.concatenate([d.expand_dims(0) for d in data], axis=0)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return _nd.array(data, dtype=data.dtype)
+
+
+class DataLoader(object):
+    """Loads batches from a Dataset (ref: dataloader.py class DataLoader)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler is "
+                                 "specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch must "
+                             "not be specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = num_workers
+        if batchify_fn is None:
+            self._batchify_fn = default_batchify_fn
+        else:
+            self._batchify_fn = batchify_fn
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[idx] for idx in batch])
+            return
+        # thread-pool pipeline with one-batch lookahead (double buffering)
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            def make(batch):
+                return self._batchify_fn([self._dataset[idx] for idx in batch])
+            futures = []
+            it = iter(self._batch_sampler)
+            depth = max(2, self._num_workers)
+            try:
+                for _ in range(depth):
+                    futures.append(pool.submit(make, next(it)))
+            except StopIteration:
+                pass
+            while futures:
+                out = futures.pop(0).result()
+                try:
+                    futures.append(pool.submit(make, next(it)))
+                except StopIteration:
+                    pass
+                yield out
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,3 @@
+"""Vision data (ref: python/mxnet/gluon/data/vision/__init__.py)."""
+from .datasets import *
+from . import transforms

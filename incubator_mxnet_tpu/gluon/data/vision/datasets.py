@@ -1,0 +1,217 @@
+"""Vision datasets (ref: python/mxnet/gluon/data/vision/datasets.py).
+
+MNIST/FashionMNIST read the standard idx gzip files, CIFAR10/100 the binary
+batches — from a local root (no network egress in this environment; point
+`root` at pre-downloaded files).  ImageRecordDataset/ImageFolderDataset
+mirror the reference's record/folder pipelines.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from .... import ndarray as _nd
+from ..dataset import Dataset, RecordFileDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    """ref: datasets.py _DownloadedDataset."""
+
+    def __init__(self, root, transform):
+        super().__init__()
+        self._transform = transform
+        self._data = None
+        self._label = None
+        root = os.path.expanduser(root)
+        self._root = root
+        if not os.path.isdir(root):
+            os.makedirs(root)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from idx files (ref: datasets.py class MNIST)."""
+
+    _train_data = "train-images-idx3-ubyte.gz"
+    _train_label = "train-labels-idx1-ubyte.gz"
+    _test_data = "t10k-images-idx3-ubyte.gz"
+    _test_label = "t10k-labels-idx1-ubyte.gz"
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        if self._train:
+            data_file = os.path.join(self._root, self._train_data)
+            label_file = os.path.join(self._root, self._train_label)
+        else:
+            data_file = os.path.join(self._root, self._test_data)
+            label_file = os.path.join(self._root, self._test_label)
+        for f in (data_file, label_file):
+            alt = f[:-3]  # allow non-gz
+            if not os.path.exists(f) and not os.path.exists(alt):
+                raise IOError(
+                    "%s not found. This environment has no network egress; "
+                    "place the MNIST idx files under %s." % (f, self._root))
+
+        def _open(path):
+            if os.path.exists(path):
+                return gzip.open(path, "rb")
+            return open(path[:-3], "rb")
+
+        with _open(label_file) as fin:
+            struct.unpack(">II", fin.read(8))
+            label = np.frombuffer(fin.read(), dtype=np.uint8).astype(np.int32)
+        with _open(data_file) as fin:
+            struct.unpack(">IIII", fin.read(16))
+            data = np.frombuffer(fin.read(), dtype=np.uint8)
+            data = data.reshape(len(label), 28, 28, 1)
+        self._data = _nd.array(data, dtype=data.dtype)
+        self._label = label
+
+
+class FashionMNIST(MNIST):
+    """ref: datasets.py class FashionMNIST (same idx format)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root=root, train=train, transform=transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 binary batches (ref: datasets.py class CIFAR10)."""
+
+    _archive_members = ["data_batch_1.bin", "data_batch_2.bin",
+                        "data_batch_3.bin", "data_batch_4.bin",
+                        "data_batch_5.bin"]
+    _test_member = "test_batch.bin"
+    _rec_size = 3073
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _read_batch(self, filename):
+        with open(filename, "rb") as fin:
+            raw = np.frombuffer(fin.read(), dtype=np.uint8)
+        data = raw.reshape(-1, self._rec_size)
+        return data[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1), \
+            data[:, 0].astype(np.int32)
+
+    def _get_data(self):
+        files = self._archive_members if self._train else [self._test_member]
+        paths = [os.path.join(self._root, f) for f in files]
+        # also allow the cifar-10-batches-bin subdir layout
+        alt = os.path.join(self._root, "cifar-10-batches-bin")
+        if not os.path.exists(paths[0]) and os.path.isdir(alt):
+            paths = [os.path.join(alt, f) for f in files]
+        for p in paths:
+            if not os.path.exists(p):
+                raise IOError(
+                    "%s not found. This environment has no network egress; "
+                    "place the CIFAR-10 binary batches under %s." % (p, self._root))
+        data, label = zip(*[self._read_batch(p) for p in paths])
+        data = np.concatenate(data)
+        label = np.concatenate(label)
+        self._data = _nd.array(data, dtype=data.dtype)
+        self._label = label
+
+
+class CIFAR100(CIFAR10):
+    """ref: datasets.py class CIFAR100."""
+
+    _rec_size = 3074
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar100"),
+                 fine_label=False, train=True, transform=None):
+        self._fine_label = fine_label
+        self._archive_members = ["train.bin"]
+        self._test_member = "test.bin"
+        super().__init__(root=root, train=train, transform=transform)
+
+    def _read_batch(self, filename):
+        with open(filename, "rb") as fin:
+            raw = np.frombuffer(fin.read(), dtype=np.uint8)
+        data = raw.reshape(-1, self._rec_size)
+        return data[:, 2:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1), \
+            data[:, 0 if not self._fine_label else 1].astype(np.int32)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Images + labels from a RecordIO file (ref: datasets.py
+    ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from .... import recordio, image
+        record = super().__getitem__(idx)
+        header, img = recordio.unpack(record)
+        img = image.imdecode(img, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """folder/label/img.jpg layout (ref: datasets.py ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                filename = os.path.join(path, filename)
+                ext = os.path.splitext(filename)[1]
+                if ext.lower() not in self._exts:
+                    continue
+                self.items.append((filename, label))
+
+    def __getitem__(self, idx):
+        from .... import image
+        with open(self.items[idx][0], "rb") as f:
+            img = image.imdecode(f.read(), self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,137 @@
+"""Vision transforms as Blocks (ref: gluon/data/vision/transforms.py appears
+in 1.3; included because Gluon vision training needs them — ToTensor,
+Normalize, Resize, crops, flips — lowered to the image ops
+(src/operator/image/ in the reference)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+from ....ndarray import NDArray
+from .... import ndarray as _nd
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom"]
+
+
+class Compose(Sequential):
+    """Sequential transform composition (ref: transforms.py Compose)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for i in transforms:
+            if isinstance(i, Block):
+                self.add(i)
+            else:
+                self.add(Lambda_(i))
+
+
+class Lambda_(Block):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, x):
+        return self._fn(x)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 → CHW float32 /255 (ref: image/to_tensor op)."""
+
+    def hybrid_forward(self, F, x):
+        out = x.astype("float32") / 255.0
+        return F.transpose(out, axes=(2, 0, 1))
+
+
+class Normalize(HybridBlock):
+    """(x - mean) / std per channel on CHW input."""
+
+    def __init__(self, mean, std):
+        super().__init__()
+        self._mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        self._std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+
+    def hybrid_forward(self, F, x):
+        mean = _nd.array(self._mean, ctx=x.context)
+        std = _nd.array(self._std, ctx=x.context)
+        return (x - mean) / std
+
+
+class Resize(Block):
+    """Resize HWC image (bilinear via jax.image.resize)."""
+
+    def __init__(self, size, keep_ratio=False):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        import jax
+        h, w = self._size[1], self._size[0]
+        v = x._read().astype("float32")
+        out = jax.image.resize(v, (h, w, v.shape[2]), method="bilinear")
+        return NDArray(out.astype(x._read().dtype), ctx=x.context)
+
+
+class CenterCrop(Block):
+    def __init__(self, size):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        w, h = self._size
+        H, W = x.shape[0], x.shape[1]
+        y0 = max((H - h) // 2, 0)
+        x0 = max((W - w) // 2, 0)
+        return NDArray(x._read()[y0:y0 + h, x0:x0 + w], ctx=x.context)
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        import jax
+        H, W = x.shape[0], x.shape[1]
+        area = H * W
+        for _ in range(10):
+            target_area = np.random.uniform(*self._scale) * area
+            aspect = np.random.uniform(*self._ratio)
+            w = int(round(np.sqrt(target_area * aspect)))
+            h = int(round(np.sqrt(target_area / aspect)))
+            if w <= W and h <= H:
+                x0 = np.random.randint(0, W - w + 1)
+                y0 = np.random.randint(0, H - h + 1)
+                crop = x._read()[y0:y0 + h, x0:x0 + w].astype("float32")
+                out = jax.image.resize(
+                    crop, (self._size[1], self._size[0], crop.shape[2]),
+                    method="bilinear")
+                return NDArray(out.astype(x._read().dtype), ctx=x.context)
+        return CenterCrop(self._size).forward(x)
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            return NDArray(x._read()[:, ::-1], ctx=x.context)
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            return NDArray(x._read()[::-1], ctx=x.context)
+        return x

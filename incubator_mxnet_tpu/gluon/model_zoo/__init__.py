@@ -1,0 +1,4 @@
+"""Model zoo (ref: python/mxnet/gluon/model_zoo/__init__.py)."""
+from . import model_store
+from . import vision
+from .vision import get_model

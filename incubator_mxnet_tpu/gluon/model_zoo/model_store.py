@@ -1,0 +1,33 @@
+"""Pretrained model store (ref: python/mxnet/gluon/model_zoo/model_store.py).
+
+This environment has no network egress: pretrained weights resolve only from
+the local root (default ~/.mxnet/models). The API shape (get_model_file,
+purge) matches the reference.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_model_file", "purge"]
+
+
+def get_model_file(name, root=os.path.join("~", ".mxnet", "models")):
+    """Locate a pretrained parameter file locally (ref: model_store.py
+    get_model_file; download path requires egress, absent here)."""
+    root = os.path.expanduser(root or os.path.join("~", ".mxnet", "models"))
+    file_path = os.path.join(root, name + ".params")
+    if os.path.exists(file_path):
+        return file_path
+    raise IOError(
+        "Pretrained model file %s is not present and this environment has no "
+        "network egress. Place the .params file there manually." % file_path)
+
+
+def purge(root=os.path.join("~", ".mxnet", "models")):
+    """ref: model_store.py purge."""
+    root = os.path.expanduser(root)
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))

@@ -1,0 +1,3 @@
+"""Gluon recurrent layers (ref: python/mxnet/gluon/rnn/__init__.py)."""
+from .rnn_cell import *
+from .rnn_layer import *

@@ -1,0 +1,179 @@
+"""Gluon Trainer (ref: python/mxnet/gluon/trainer.py, 238 LoC).
+
+Applies an Optimizer to a set of Parameters. When a KVStore is attached the
+gradient path mirrors the reference (trainer.py:156 _update → kvstore
+push/pull or update_on_kvstore); on a device mesh the same step lowers to
+psum-over-ICI via the parallel package instead of Comm/NCCL reductions.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt
+from .parameter import ParameterDict, Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer(object):
+    """ref: gluon/trainer.py class Trainer."""
+
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got %s." % (type(params)))
+        self._params = []
+        for param in params:
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    "got list of %s." % (type(param)))
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = optimizer_params.get("rescale_grad", 1.0)
+        self._contexts = self._check_contexts()
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kv_initialized = False
+        self._kvstore = kvstore
+
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx()
+            assert contexts is None or contexts == ctx, \
+                "All Parameters must be initialized on the same set of contexts, " \
+                "but Parameter %s is initialized on %s while previous Parameters " \
+                "are initialized on %s." % (param.name, str(ctx), str(contexts))
+            contexts = ctx
+        return contexts
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an Optimizer " \
+                "instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)
+                          for _ in self._contexts]
+
+    def _init_kvstore(self):
+        """Attach kvstore if requested (ref: trainer.py _init_kvstore)."""
+        from .. import kvstore as kvs_mod
+        arg_arrays = {param.name: param.data(self._contexts[0])
+                      for param in self._params}
+        kvstore, update_on_kvstore = kvs_mod.create_kvstore(
+            self._kvstore, len(self._contexts), arg_arrays)
+        if kvstore:
+            if self._compression_params:
+                kvstore.set_gradient_compression(self._compression_params)
+            if "dist" in kvstore.type:
+                update_on_kvstore = False
+            for i, param in enumerate(self._params):
+                param_arrays = param.list_data()
+                kvstore.init(i, param_arrays[0])
+                if param.grad_req != "null":
+                    kvstore.pull(i, param_arrays, priority=-i)
+            if update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+            self._kvstore_obj = kvstore
+            self._update_on_kvstore = update_on_kvstore
+        else:
+            self._kvstore_obj = None
+            self._update_on_kvstore = False
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning("Optimizer has to be defined before its learning "
+                              "rate can be accessed.")
+        return self._optimizer.lr
+
+    def set_learning_rate(self, lr):
+        """ref: trainer.py set_learning_rate."""
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning("Optimizer has to be defined before its learning "
+                              "rate is mutated.")
+        self._optimizer.lr = lr
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Apply one optimization step with grads scaled by 1/batch_size
+        (ref: trainer.py:156 step)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        """ref: trainer.py allreduce_grads (1.3+, for grad accumulation)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore_obj is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                self._kvstore_obj.push(i, param.list_grad(), priority=-i)
+                if not self._update_on_kvstore:
+                    self._kvstore_obj.pull(i, param.list_grad(), priority=-i)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """ref: trainer.py update (apply updates without reduce)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        if self._kvstore_obj is not None and self._update_on_kvstore:
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    self._kvstore_obj.pull(i, param.list_data(), priority=-i)
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            for upd, arr, grad in zip(self._updaters, param.list_data(),
+                                      param.list_grad()):
+                upd(i, grad, arr)
+
+    def save_states(self, fname):
+        """ref: trainer.py:202 save_states."""
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            with open(fname, "wb") as fout:
+                fout.write(self._kvstore_obj._updater.get_states(dump_optimizer=True))
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        """ref: trainer.py:218 load_states."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, "rb") as f:
+            states = f.read()
+        if self._update_on_kvstore:
+            self._kvstore_obj._updater.set_states(states)
+            self._kvstore_obj._updater.optimizer.param_dict = {
+                i: param for i, param in enumerate(self._params)}
+            self._optimizer = self._kvstore_obj._updater.optimizer
+        else:
+            for updater in self._updaters:
+                updater.set_states(states)
+            self._optimizer = self._updaters[0].optimizer
+        self._optimizer.param_dict = {i: param
+                                      for i, param in enumerate(self._params)}

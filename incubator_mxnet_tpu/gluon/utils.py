@@ -1,0 +1,103 @@
+"""Gluon utilities (ref: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+from ..ndarray import NDArray
+from .. import ndarray as _nd
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch axis into num_slice pieces (ref: utils.py split_data)."""
+    size = data.shape[batch_axis]
+    if size < num_slice:
+        raise ValueError(
+            "Too many slices for data with shape %s. Arguments are " \
+            "num_slice=%d and batch_axis=%d." % (str(data.shape), num_slice, batch_axis))
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along axis %d. " \
+            "Use a batch size that's multiple of %d or set even_split=False to allow " \
+            "uneven partitioning of data." % (
+                str(data.shape), num_slice, batch_axis, num_slice))
+    step = size // num_slice
+    if batch_axis == 0:
+        slices = [data[i * step:(i + 1) * step] if i < num_slice - 1 else data[i * step:size]
+                  for i in range(num_slice)]
+    else:
+        from ..ops.registry import get_op
+        from ..ndarray.ndarray import invoke
+        slices = [invoke(get_op("slice_axis"), [data],
+                         {"axis": batch_axis, "begin": i * step,
+                          "end": (i + 1) * step if i < num_slice - 1 else size})
+                  for i in range(num_slice)]
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split and move each slice to one context (ref: utils.py split_and_load)."""
+    if not isinstance(data, NDArray):
+        data = _nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm):
+    """Rescale arrays so that the joint L2 norm ≤ max_norm
+    (ref: utils.py clip_global_norm)."""
+    assert len(arrays) > 0
+    total_norm = 0.0
+    for arr in arrays:
+        v = arr._read()
+        total_norm += float((v.astype("float32") ** 2).sum())
+    total_norm = np.sqrt(total_norm)
+    if not np.isfinite(total_norm):
+        import warnings
+        warnings.warn(UserWarning("nan or inf is detected. Clipping results "
+                                  "will be undefined."), stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr._write(arr._read() * scale)
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    """ref: utils.py check_sha1."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None):
+    """ref: utils.py download. This environment has no egress; only
+    file:// URLs and existing cached files are honored."""
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if overwrite or not os.path.exists(fname) or (
+            sha1_hash and not check_sha1(fname, sha1_hash)):
+        if url.startswith("file://"):
+            import shutil
+            shutil.copyfile(url[len("file://"):], fname)
+        else:
+            raise IOError(
+                "download(%r) requires network egress which this environment "
+                "does not provide; place the file at %r manually" % (url, fname))
+    return fname

@@ -1,0 +1,3 @@
+"""Image API (ref: python/mxnet/image/__init__.py)."""
+from .image import *
+from . import image

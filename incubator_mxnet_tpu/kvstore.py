@@ -1,0 +1,244 @@
+"""KVStore: key-value parameter synchronization.
+
+TPU-native rebirth of src/kvstore/ + python/mxnet/kvstore.py:
+
+* ``local`` / ``device`` — single-process multi-device reduce/broadcast
+  (ref: kvstore_local.h:52, comm.h CommCPU/CommDevice).  On TPU the "device
+  reduce" is an XLA all-reduce when arrays live on a mesh (parallel package);
+  for per-context replica lists (Gluon Trainer, Module) it is a tree-sum in
+  one fused XLA program.
+* ``nccl`` maps to ``device`` — ICI collectives replace NCCL rings
+  (ref: kvstore_nccl.h:62 → psum over ICI, SURVEY §2.4).
+* ``dist_sync``/``dist_async`` — multi-host path built on jax.distributed
+  (see parallel/dist.py); single-process fallback behaves like local with
+  rank 0 of 1, so the same training scripts run anywhere.
+* Gradient compression: 2-bit stochastic-threshold quantization with
+  residual accumulation — same algebra as the reference
+  (src/kvstore/gradient_compression.h:37-132), as an XLA kernel.
+* ``set_optimizer`` runs the updater on the store (server-side optimizer,
+  ref: kvstore_dist_server.h:145) — here the "server" is the store object.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray
+from .ndarray import ndarray as _nd
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create", "create_kvstore"]
+
+
+def _key_str(key):
+    return str(key)
+
+
+class _TwoBitCompressor(object):
+    """2-bit gradient compression with residual (ref:
+    src/kvstore/gradient_compression.h:37-132 — quantize_2bit kernel)."""
+
+    def __init__(self, threshold=0.5):
+        self.threshold = float(threshold)
+        self.residuals = {}
+
+    def compress(self, key, grad):
+        t = self.threshold
+        r = self.residuals.get(key)
+        g = grad._read()
+        if r is None:
+            r = jnp.zeros_like(g)
+        acc = r + g
+        q = jnp.where(acc >= t, t, jnp.where(acc <= -t, -t, 0.0)).astype(g.dtype)
+        self.residuals[key] = acc - q
+        return NDArray(q, ctx=grad._ctx)
+
+
+class KVStore(object):
+    """Single-process store (ref: include/mxnet/kvstore.h:47-382 API)."""
+
+    def __init__(self, type_="local"):
+        self._type = type_
+        self._store = {}           # key -> NDArray (the "server" copy)
+        self._updater = None
+        self._compressor = None
+        self._str_keys = None
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        """ref: kvstore.h get_rank — single-process is rank 0."""
+        from .parallel import dist
+        return dist.rank()
+
+    @property
+    def num_workers(self):
+        from .parallel import dist
+        return dist.num_workers()
+
+    # -- data path ---------------------------------------------------------
+    def init(self, key, value):
+        """ref: KVStore::Init — one-time value registration."""
+        keys, values = self._normalize(key, value)
+        for k, vlist in zip(keys, values):
+            if k in self._store:
+                raise ValueError("duplicate init of key %s" % k)
+            self._store[k] = vlist[0].copy()
+
+    def push(self, key, value, priority=0):
+        """Aggregate value(s) into the store (ref: KVStore::Push).
+
+        Multi-device lists are reduced (CommCPU/CommDevice::Reduce); with an
+        updater set, the update is applied store-side (server semantics).
+        """
+        keys, values = self._normalize(key, value)
+        for k, vlist in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError("key %s has not been initialized" % k)
+            red = self._reduce(vlist)
+            if self._compressor is not None:
+                red = self._compressor.compress(k, red)
+            if self._updater is not None:
+                self._updater(_int_key(k), red, self._store[k])
+            else:
+                # no updater: store holds the reduced value (ref:
+                # kvstore_local.h PushImpl assigns local = merged)
+                self._store[k]._write(red._read().astype(self._store[k].dtype))
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Broadcast store value into out list (ref: KVStore::Pull)."""
+        assert out is not None
+        keys, outs = self._normalize(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %s has not been initialized" % k)
+            src = self._store[k]
+            for o in olist:
+                o._write(src._read().astype(o.dtype))
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only selected rows (ref: KVStore::PullRowSparse,
+        kvstore_local.h PullRowSparseImpl)."""
+        assert out is not None and row_ids is not None
+        keys, outs = self._normalize(key, out)
+        if isinstance(row_ids, NDArray):
+            row_ids = [row_ids] * len(outs[0])
+        for k, olist in zip(keys, outs):
+            src = self._store[k]._read()
+            for o, rid in zip(olist, row_ids):
+                idx = rid._read().astype(jnp.int32)
+                rows = jnp.take(src, idx, axis=0)
+                # scatter selected rows into dense out, rest zero (row_sparse
+                # semantic projected onto dense TPU storage)
+                dense = jnp.zeros(o.shape, o._read().dtype)
+                dense = dense.at[idx].set(rows.astype(o._read().dtype))
+                o._write(dense)
+
+    # -- reductions --------------------------------------------------------
+    @staticmethod
+    def _reduce(vlist):
+        if len(vlist) == 1:
+            return vlist[0]
+        acc = vlist[0]._read()
+        for v in vlist[1:]:
+            acc = acc + v._read()
+        return NDArray(acc, ctx=vlist[0]._ctx)
+
+    @staticmethod
+    def _normalize(key, value):
+        single = not isinstance(key, (list, tuple))
+        keys = [key] if single else list(key)
+        if single:
+            values = [value if isinstance(value, (list, tuple)) else [value]]
+        else:
+            values = [v if isinstance(v, (list, tuple)) else [v] for v in value]
+        return [_key_str(k) for k in keys], values
+
+    # -- optimizer / updater ----------------------------------------------
+    def set_updater(self, updater):
+        """ref: kvstore.py _set_updater / KVStoreSetUpdater."""
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        """ref: kvstore.py set_optimizer — the local store shares the live
+        optimizer object (so Trainer's per-step rescale_grad / lr mutations
+        apply); only the dist path pickles it to servers
+        (kvstore_dist_server.h kController command channel)."""
+        self._updater = opt.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        """ref: kvstore.py set_gradient_compression (2bit only, like ref)."""
+        ctype = compression_params.get("type", "2bit")
+        if ctype != "2bit":
+            raise ValueError("Unsupported type of gradient compression: %s" % ctype)
+        self._compressor = _TwoBitCompressor(
+            compression_params.get("threshold", 0.5))
+
+    # -- distributed-only API (graceful single-process behavior) -----------
+    def barrier(self):
+        from .parallel import dist
+        dist.barrier()
+
+    def send_command_to_servers(self, head, body):
+        return
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def _int_key(k):
+    try:
+        return int(k)
+    except ValueError:
+        return k
+
+
+def create(name="local"):
+    """Factory (ref: kvstore.cc:40-77 KVStore::Create by type string)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu",
+                "local_allreduce_device", "device", "nccl"):
+        return KVStore("device" if name in ("device", "nccl") else "local")
+    if name in ("dist_sync", "dist_async", "dist_device_sync"):
+        from .parallel import dist
+        return dist.DistKVStore(name)
+    raise ValueError("Unknown KVStore type %s" % name)
+
+
+def create_kvstore(kvstore, num_device, arg_params):
+    """Resolve a kvstore spec into (store, update_on_kvstore)
+    (ref: python/mxnet/model.py _create_kvstore)."""
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None
+        else:
+            kv = create(kvstore)
+            if kvstore == "local":
+                max_size = max(np.prod(param.shape) for param in arg_params.values())
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    if kv is None:
+        update_on_kvstore = False
+    return kv, update_on_kvstore

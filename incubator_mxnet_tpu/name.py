@@ -1,0 +1,59 @@
+"""Automatic naming of layers/symbols (ref: python/mxnet/name.py).
+
+``NameManager`` hands out ``dense0``, ``conv1``-style unique names; ``Prefix``
+prepends a fixed prefix. Gluon's ``_BlockScope`` and Symbol creation both
+consult the current manager, exactly as the reference does.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix"]
+
+_current = threading.local()
+
+
+class NameManager(object):
+    """ref: name.py class NameManager."""
+
+    def __init__(self):
+        self._counter = {}
+        self._old_manager = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = "%s%d" % (hint, self._counter[hint])
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        if not hasattr(_current, "value"):
+            _current.value = NameManager()
+        self._old_manager = _current.value
+        _current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old_manager
+        _current.value = self._old_manager
+
+    @staticmethod
+    def current():
+        if not hasattr(_current, "value"):
+            _current.value = NameManager()
+        return _current.value
+
+
+class Prefix(NameManager):
+    """ref: name.py class Prefix."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name

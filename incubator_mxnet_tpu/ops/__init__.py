@@ -14,5 +14,6 @@ from . import nn          # noqa: F401  conv/fc/norm/act/pool/loss-outputs
 from . import init_ops    # noqa: F401  zeros/ones/arange/...
 from . import random_ops  # noqa: F401  samplers
 from . import optimizer_ops  # noqa: F401  fused updates
+from . import rnn         # noqa: F401  fused RNN + CTC
 
 __all__ = ["Operator", "get_op", "list_ops", "register", "alias"]

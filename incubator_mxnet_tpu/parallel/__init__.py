@@ -1,0 +1,17 @@
+"""Parallelism: device meshes, sharded training steps, distributed init.
+
+TPU-native replacement for the reference's KVStore/Comm/ps-lite stack
+(SURVEY §2.4): instead of explicit reduce/broadcast engine ops, parallelism
+is expressed as jax.sharding over a Mesh and XLA inserts the collectives
+(psum over ICI intra-slice, DCN collectives across slices).
+
+Modules:
+  mesh   — Mesh construction + named axis conventions (dp/tp/pp/sp/ep)
+  dist   — multi-host process bootstrap (jax.distributed), rank/barrier,
+           DistKVStore (the dist_sync/dist_async façade)
+  data_parallel — DataParallelTrainer: pjit'd train step, batch-sharded
+"""
+from . import mesh
+from . import dist
+from .mesh import make_mesh, data_parallel_mesh
+from .data_parallel import DataParallelTrainer

@@ -1,0 +1,203 @@
+"""DataParallelTrainer: the fused, sharded training step.
+
+TPU-native replacement for Module's DataParallelExecutorGroup + KVStore
+update loop (ref: python/mxnet/module/executor_group.py:129,267 +
+gluon/trainer.py:156):
+
+* the whole train step — forward, loss, backward, optimizer update — is ONE
+  jitted XLA program (the reference needed engine bulking + fused optimizer
+  ops to approximate this; XLA gives it outright),
+* the batch is sharded over the mesh "dp" axis; parameters are replicated;
+  XLA inserts the gradient all-reduce (psum over ICI) exactly where the
+  reference ran Comm::Reduce / NCCL allreduce,
+* parameters live on device between steps (donated buffers — no host
+  round-trips); ``sync_params()`` writes them back into the Gluon Block.
+
+Works on any mesh: 1 real TPU chip, a v5e slice, or the 8-device virtual
+CPU mesh used by tests and the driver's multi-chip dry-run.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ndarray import NDArray
+from .. import autograd, random_state
+from ..ops.registry import get_op
+from .mesh import data_parallel_mesh
+
+__all__ = ["DataParallelTrainer", "pure_optimizer"]
+
+
+def pure_optimizer(name, **hyper):
+    """(init_state, update) pair built from the fused optimizer update ops
+    (ops/optimizer_ops.py — the same kernels the eager Optimizer uses)."""
+    name = name.lower()
+    if name == "sgd":
+        momentum = hyper.get("momentum", 0.0)
+        if momentum:
+            op = get_op("sgd_mom_update").fcompute
+
+            def init(w):
+                return (jnp.zeros_like(w),)
+
+            def update(w, g, state, lr):
+                new_w, new_mom = op(w, g, state[0], lr=lr,
+                                    momentum=momentum,
+                                    wd=hyper.get("wd", 0.0),
+                                    rescale_grad=hyper.get("rescale_grad", 1.0),
+                                    clip_gradient=hyper.get("clip_gradient", -1.0))
+                return new_w, (new_mom,)
+        else:
+            op = get_op("sgd_update").fcompute
+
+            def init(w):
+                return ()
+
+            def update(w, g, state, lr):
+                return op(w, g, lr=lr, wd=hyper.get("wd", 0.0),
+                          rescale_grad=hyper.get("rescale_grad", 1.0),
+                          clip_gradient=hyper.get("clip_gradient", -1.0)), ()
+        return init, update
+    if name == "adam":
+        op = get_op("adam_update").fcompute
+        b1 = hyper.get("beta1", 0.9)
+        b2 = hyper.get("beta2", 0.999)
+
+        def init(w):
+            return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros((), jnp.int32))
+
+        def update(w, g, state, lr):
+            mean, var, t = state
+            t = t + 1
+            tf = t.astype(jnp.float32)
+            lr_t = lr * jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
+            new_w, new_mean, new_var = op(
+                w, g, mean, var, lr=lr_t, beta1=b1, beta2=b2,
+                epsilon=hyper.get("epsilon", 1e-8), wd=hyper.get("wd", 0.0),
+                rescale_grad=hyper.get("rescale_grad", 1.0),
+                clip_gradient=hyper.get("clip_gradient", -1.0))
+            return new_w, (new_mean, new_var, t)
+        return init, update
+    raise ValueError("pure_optimizer: unsupported optimizer %r "
+                     "(sgd and adam cover the fused-step path; others run "
+                     "through the eager Trainer)" % name)
+
+
+class DataParallelTrainer(object):
+    """One-jit data-parallel trainer for a Gluon HybridBlock."""
+
+    def __init__(self, block, loss, optimizer="sgd", optimizer_params=None,
+                 mesh=None, donate=True):
+        self.block = block
+        self.loss = loss
+        self.mesh = mesh if mesh is not None else data_parallel_mesh()
+        optimizer_params = dict(optimizer_params or {})
+        self._lr = optimizer_params.pop("learning_rate", 0.01)
+        self._opt_init, self._opt_update = pure_optimizer(optimizer,
+                                                          **optimizer_params)
+        self._donate = donate
+        self._params = None        # name -> jax array (device-resident)
+        self._opt_state = None
+        self._trainable = None
+        self._jit_cache = {}
+
+    # -- parameter plumbing ------------------------------------------------
+    def _gather_params(self, *example_args):
+        blk_params = self.block.collect_params()
+        for p in blk_params.values():
+            if p._data is None and p._deferred_init:
+                # resolve deferred shapes with one eager pass
+                self.block._run_deferred_init(*example_args)
+                break
+        repl = NamedSharding(self.mesh, P())
+        self._params = {}
+        self._trainable = []
+        for name, p in blk_params.items():
+            v = p.data()._read()
+            self._params[name] = jax.device_put(v, repl)
+            if p.grad_req != "null":
+                self._trainable.append(name)
+        self._opt_state = {n: jax.tree.map(lambda x: jax.device_put(x, repl),
+                                           self._opt_init(self._params[n]))
+                           for n in self._trainable}
+
+    def sync_params(self):
+        """Write device params back into the Block (checkpoint/export path)."""
+        blk_params = self.block.collect_params()
+        for name, v in self._params.items():
+            blk_params[name].data()._write(v)
+
+    # -- the pure step -----------------------------------------------------
+    def _make_step(self, train=True):
+        block, loss_blk = self.block, self.loss
+        trainable = list(self._trainable)
+        opt_update = self._opt_update
+
+        def forward_loss(trainable_vals, frozen_vals, x, y, rng):
+            all_vals = dict(frozen_vals)
+            all_vals.update(trainable_vals)
+            shadows = {n: NDArray(v) for n, v in all_vals.items()}
+            ndx, ndy = NDArray(x), NDArray(y)
+            with random_state.use_key(rng):
+                with autograd._scope(recording=False, training=train):
+                    with block._trace_params(shadows):
+                        out = block.hybrid_forward_dispatch(ndx)
+                    per_sample = loss_blk(out, ndy)
+            aux = {n: s._read() for n, s in shadows.items() if s._version > 0}
+            return jnp.mean(per_sample._read()), aux
+
+        def step(params, opt_state, x, y, rng, lr):
+            tvals = {n: params[n] for n in trainable}
+            fvals = {n: v for n, v in params.items() if n not in tvals}
+            (loss_val, aux), grads = jax.value_and_grad(
+                forward_loss, has_aux=True)(tvals, fvals, x, y, rng)
+            new_params = dict(params)
+            new_opt = {}
+            for n in trainable:
+                new_w, new_s = opt_update(params[n], grads[n], opt_state[n], lr)
+                new_params[n] = new_w.astype(params[n].dtype)
+                new_opt[n] = new_s
+            for n, v in aux.items():
+                if n not in tvals:
+                    new_params[n] = v.astype(new_params[n].dtype)
+            return new_params, new_opt, loss_val
+
+        return step
+
+    def compile(self, *example_args):
+        """Build + jit the step for the example shapes; returns the jitted fn."""
+        if self._params is None:
+            self._gather_params(*example_args)
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in example_args)
+        if key not in self._jit_cache:
+            repl = NamedSharding(self.mesh, P())
+            batch = NamedSharding(self.mesh, P("dp"))
+            step = self._make_step(train=True)
+            self._jit_cache[key] = jax.jit(
+                step,
+                in_shardings=(repl, repl, batch, batch, repl, repl),
+                out_shardings=(repl, repl, repl),
+                donate_argnums=(0, 1) if self._donate else ())
+        return self._jit_cache[key]
+
+    def step(self, data, label):
+        """Run one sharded train step; returns the (host) scalar loss."""
+        x = data._read() if isinstance(data, NDArray) else jnp.asarray(data)
+        y = label._read() if isinstance(label, NDArray) else jnp.asarray(label)
+        fn = self.compile(x, y)
+        rng = random_state.next_key()
+        self._params, self._opt_state, loss_val = fn(
+            self._params, self._opt_state, x, y, rng,
+            jnp.asarray(self._lr, jnp.float32))
+        return loss_val
+
+    @property
+    def learning_rate(self):
+        return self._lr
+
+    def set_learning_rate(self, lr):
+        self._lr = lr

@@ -1,0 +1,112 @@
+"""Multi-host distributed bootstrap + the dist_* KVStore façade.
+
+TPU-native replacement for ps-lite (src/kvstore/kvstore_dist.h) and the
+dmlc tracker (tools/launch.py): process coordination is
+``jax.distributed.initialize`` (the jax coordination service plays the
+scheduler/Postoffice role), data-parallel gradient sync is an XLA
+all-reduce over ICI/DCN instead of ZPush/ZPull to servers.
+
+The KVStore *API* survives intact (SURVEY §5.8): init/push/pull/
+row_sparse_pull/barrier/rank/num_workers/set_optimizer — scripts written
+against dist_sync run unchanged; the transport underneath is collectives.
+`dist_async`'s push-immediately semantics are outside XLA's synchronous
+model; DistKVStore("dist_async") runs sync with a documented warning
+(SURVEY §2.4 marks it a non-goal).
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as np
+import jax
+
+from ..kvstore import KVStore
+
+__all__ = ["init_process", "rank", "num_workers", "barrier", "DistKVStore"]
+
+_initialized = False
+
+
+def init_process(coordinator_address=None, num_processes=None, process_id=None):
+    """Initialize multi-host jax.distributed (replaces DMLC_ROLE/tracker env
+    bootstrap, tools/launch.py:29). Reads standard env vars if args omitted."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("MX_COORDINATOR")
+    num_processes = num_processes or int(os.environ.get("MX_NUM_PROCESSES", "0")) or None
+    process_id = process_id if process_id is not None else (
+        int(os.environ["MX_PROCESS_ID"]) if "MX_PROCESS_ID" in os.environ else None)
+    if coordinator_address:
+        jax.distributed.initialize(coordinator_address, num_processes, process_id)
+    _initialized = True
+
+
+def rank():
+    """Worker rank (ref: KVStore::get_rank / MXKVStoreGetRank)."""
+    return jax.process_index()
+
+
+def num_workers():
+    """ref: KVStore::get_group_size."""
+    return jax.process_count()
+
+
+def barrier():
+    """Global barrier (ref: KVStore::Barrier → ps::Postoffice::Barrier).
+
+    Implemented as a tiny psum across all processes — every host must
+    arrive before XLA returns."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("mx_kvstore_barrier")
+
+
+def num_dead_nodes():
+    """ref: MXKVStoreGetNumDeadNode — jax coordination service terminates
+    the job on member failure, so a live process always observes 0."""
+    return 0
+
+
+class DistKVStore(KVStore):
+    """dist_sync / dist_device_sync / dist_async over jax.distributed."""
+
+    def __init__(self, type_):
+        super().__init__(type_)
+        if type_ == "dist_async":
+            logging.warning(
+                "dist_async parameter-server semantics are outside XLA's "
+                "synchronous execution model; running synchronously "
+                "(equivalent to dist_sync). See SURVEY.md §2.4.")
+        init_process()
+
+    def push(self, key, value, priority=0):
+        """Reduce locally, compress, then all-reduce across workers.
+
+        Compression runs BEFORE the cross-worker exchange — that is its whole
+        point (worker-side quantize, server-side dequant+sum, ref:
+        gradient_compression.h); the 2-bit values sum exactly because each is
+        in {-t, 0, +t}."""
+        keys, values = self._normalize(key, value)
+        for k, vlist in zip(keys, values):
+            red = self._reduce(vlist)
+            if self._compressor is not None:
+                red = self._compressor.compress(k, red)
+            if num_workers() > 1:
+                from jax.experimental import multihost_utils
+                summed = multihost_utils.process_allgather(red._read())
+                red._write(summed.sum(axis=0))
+            from ..kvstore import _int_key
+            if self._updater is not None:
+                self._updater(_int_key(k), red, self._store[k])
+            else:
+                self._store[k]._write(red._read().astype(self._store[k].dtype))
+
+    def set_optimizer(self, optimizer):
+        """dist path: pickle round-trip, as the reference ships the optimizer
+        to servers (kvstore.py set_optimizer → _send_command_to_servers)."""
+        import pickle
+        from .. import optimizer as opt
+        self._updater = opt.get_updater(pickle.loads(pickle.dumps(optimizer)))

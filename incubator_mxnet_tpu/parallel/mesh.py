@@ -1,0 +1,46 @@
+"""Device mesh construction and axis conventions.
+
+The reference assigns work to devices by Context lists
+(DataParallelExecutorGroup) and `ctx_group` attrs (PlaceDevice pass);
+TPU-natively the device topology is a named ``jax.sharding.Mesh`` and
+placement is a sharding annotation.  Axis name conventions used throughout
+the framework:
+
+  "dp" — data parallel (batch dim)           ⇔ KVStore local/device/dist
+  "tp" — tensor/model parallel               ⇔ ctx_group model parallelism
+  "pp" — pipeline stages                     ⇔ (new capability)
+  "sp" — sequence/context parallel           ⇔ (new capability, ring attn)
+  "ep" — expert parallel                     ⇔ (new capability)
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, PartitionSpec, NamedSharding
+
+__all__ = ["make_mesh", "data_parallel_mesh", "P", "NamedSharding", "Mesh"]
+
+P = PartitionSpec
+
+
+def make_mesh(axis_sizes, devices=None):
+    """Build a Mesh from {"dp": 4, "tp": 2, ...} (row-major over devices)."""
+    names = tuple(axis_sizes.keys())
+    sizes = tuple(int(v) for v in axis_sizes.values())
+    if devices is None:
+        devices = jax.devices()
+    n = int(np.prod(sizes))
+    assert len(devices) >= n, \
+        "mesh needs %d devices, have %d" % (n, len(devices))
+    arr = np.array(devices[:n]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def data_parallel_mesh(num_devices=None, devices=None):
+    """1-D dp mesh over all (or the first N) devices."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return make_mesh({"dp": len(devices)}, devices)

@@ -34,3 +34,23 @@ def next_key():
     k = jax.random.fold_in(_state.key, _state.count)
     _state.count += 1
     return k
+
+
+from contextlib import contextmanager
+
+
+@contextmanager
+def use_key(key):
+    """Thread an explicit key (possibly a tracer) as the root for the scope.
+
+    Used by traced/hybridized execution so random ops inside jit draw from a
+    per-call key argument instead of baking host-side state into the trace.
+    """
+    _ensure()
+    prev = (_state.key, _state.count)
+    _state.key = key
+    _state.count = 0
+    try:
+        yield
+    finally:
+        _state.key, _state.count = prev

@@ -1,0 +1,242 @@
+"""RecordIO: record-packed binary dataset files.
+
+TPU-native reimplementation of python/mxnet/recordio.py over the dmlc-core
+RecordIO wire format (3rdparty dmlc-core recordio.h, surfaced through the C
+API MXRecordIOWriter*/Reader* functions — SURVEY §2.1 Data IO row):
+
+  [kMagic:4B][cflag:3bits|length:29bits:4B][payload][pad to 4B]
+
+Pure Python here (the hot path — image decode + augment — lives in the C++
+data plane later; the *format* must be bit-compatible so .rec files
+interchange with the reference).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import numbers
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_kMagic = 0xced7230a
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _decode_lrec(lrec):
+    return lrec >> 29, lrec & ((1 << 29) - 1)
+
+
+class MXRecordIO(object):
+    """Sequential record reader/writer (ref: recordio.py class MXRecordIO →
+    dmlc::RecordIOWriter/Reader)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        """Override pickling behavior (ref: recordio.py __getstate__)."""
+        is_open = self.is_open
+        self.close()
+        d = dict(self.__dict__)
+        d["is_open"] = is_open
+        d.pop("handle", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__ = d
+        self.handle = None
+        is_open = d.get("is_open", False)
+        self.is_open = False
+        if is_open:
+            self.open()
+
+    def close(self):
+        if not self.is_open:
+            return
+        self.handle.close()
+        self.is_open = False
+
+    def reset(self):
+        """ref: recordio.py reset."""
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        """Write one record (ref: MXRecordIOWriterWriteRecord)."""
+        assert self.writable
+        data = bytes(buf)
+        # dmlc recordio: no escaping needed for our write path because we
+        # write magic-aligned records with explicit length framing
+        self.handle.write(struct.pack("<II", _kMagic,
+                                      _encode_lrec(0, len(data))))
+        self.handle.write(data)
+        pad = (4 - len(data) % 4) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        """Read one record, or None at EOF (ref: MXRecordIOReaderReadRecord)."""
+        assert not self.writable
+        head = self.handle.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _kMagic:
+            raise IOError("Invalid RecordIO magic in %s" % self.uri)
+        cflag, length = _decode_lrec(lrec)
+        data = self.handle.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.read(pad)
+        # multi-part records (cflag != 0) are concatenated
+        while cflag in (1, 2):  # begin/middle of a split record
+            head = self.handle.read(8)
+            magic, lrec = struct.unpack("<II", head)
+            cflag, length = _decode_lrec(lrec)
+            data += self.handle.read(length)
+            pad = (4 - length % 4) % 4
+            if pad:
+                self.handle.read(pad)
+            if cflag == 3:  # end
+                break
+        return data
+
+    def tell(self):
+        return self.handle.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access records via an index file (ref: recordio.py
+    MXIndexedRecordIO; idx format: "key\\tposition\\n")."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+        if self.writable:
+            self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        if not self.is_open:
+            return
+        super().close()
+        if self.fidx is not None and not self.fidx.closed:
+            self.fidx.close()
+
+    def seek(self, idx):
+        """ref: recordio.py seek."""
+        assert not self.writable
+        pos = self.idx[idx]
+        self.handle.seek(pos)
+
+    def read_idx(self, idx):
+        """ref: recordio.py read_idx."""
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        """ref: recordio.py write_idx."""
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# header packed in front of each record's payload
+# (ref: recordio.py IRHeader + pack: struct IRHeader {flag, label, id, id2})
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a header + raw bytes into one record payload (ref: recordio.py
+    pack; flag>0 means `label` is a flag-length float array appended after
+    the header)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        packed = struct.pack(_IR_FORMAT, header.flag, header.label, header.id,
+                             header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        packed = struct.pack(_IR_FORMAT, header.flag, header.label, header.id,
+                             header.id2) + label.tobytes()
+    return packed + s
+
+
+def unpack(s):
+    """Inverse of pack (ref: recordio.py unpack)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode image + header into a record (ref: recordio.py pack_img)."""
+    import cv2
+    if img_fmt in (".jpg", ".jpeg"):
+        encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+    elif img_fmt == ".png":
+        encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+    else:
+        encode_params = None
+    ret, buf = cv2.imencode(img_fmt, img, encode_params)
+    assert ret, "failed to encode image"
+    return pack(header, buf.tobytes())
+
+
+def unpack_img(s, iscolor=-1):
+    """Decode a packed image record (ref: recordio.py unpack_img)."""
+    import cv2
+    header, s = unpack(s)
+    img = np.frombuffer(s, dtype=np.uint8)
+    img = cv2.imdecode(img, iscolor)
+    return header, img

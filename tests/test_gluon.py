@@ -1,0 +1,369 @@
+"""Gluon tests (parity model: tests/python/unittest/test_gluon.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, autograd
+from incubator_mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier", ctx=mx.cpu(0))
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+    assert p.list_data()[0].shape == (10, 10)
+
+
+def test_paramdict():
+    params = gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 10))
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu(0))
+    params.save("/tmp/test_paramdict.params")
+    params.load("/tmp/test_paramdict.params", mx.cpu(0))
+
+
+def test_parameter_sharing():
+    class Net(gluon.Block):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.dense0 = nn.Dense(5, in_units=5)
+                self.dense1 = nn.Dense(5, in_units=5)
+
+        def forward(self, x):
+            return self.dense1(self.dense0(x))
+
+    net1 = Net(prefix="net1_")
+    net2 = Net(prefix="net2_", params=net1.collect_params())
+    net1.collect_params().initialize()
+    net2(mx.nd.zeros((3, 5)))
+    net1.save_params("/tmp/net1.params")
+    net3 = Net(prefix="net3_")
+    net3.load_params("/tmp/net1.params", mx.cpu())
+
+
+def test_basic_dense_shapes():
+    model = nn.Sequential()
+    model.add(nn.Dense(128, activation="tanh", in_units=10),
+              nn.Dense(64, activation="tanh", in_units=128),
+              nn.Dense(32, in_units=64))
+    model.initialize()
+    x = mx.nd.array(np.random.randn(2, 10).astype(np.float32))
+    assert model(x).shape == (2, 32)
+
+
+def test_dense_flatten_false():
+    model = nn.Dense(10, flatten=False, in_units=5)
+    model.initialize()
+    x = mx.nd.array(np.random.randn(2, 3, 5).astype(np.float32))
+    assert model(x).shape == (2, 3, 10)
+
+
+def test_deferred_init_and_hybridize():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(np.random.randn(3, 7).astype(np.float32))
+    y0 = net(x)
+    net.hybridize()
+    y1 = net(x)
+    np.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_hybrid_training_matches_eager():
+    def build():
+        mx.random.seed(42)
+        net = nn.HybridSequential(prefix="m_")
+        with net.name_scope():
+            net.add(nn.Dense(8, activation="relu", in_units=6))
+            net.add(nn.Dense(3, in_units=8))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    x = mx.nd.array(np.random.randn(4, 6).astype(np.float32))
+    label = mx.nd.array(np.array([0, 1, 2, 1], np.float32))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    losses = []
+    for hybrid in (False, True):
+        net = build()
+        if hybrid:
+            net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+        cur = []
+        for _ in range(3):
+            with autograd.record():
+                L = loss_fn(net(x), label)
+            L.backward()
+            trainer.step(4)
+            cur.append(float(L.mean().asscalar()))
+        losses.append(cur)
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-4)
+
+
+def test_conv_pool_stack():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"))
+        net.add(nn.MaxPool2D(2))
+        net.add(nn.Conv2D(16, kernel_size=3, padding=1))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.add(nn.GlobalAvgPool2D())
+        net.add(nn.Flatten())
+        net.add(nn.Dense(10))
+    net.initialize()
+    x = mx.nd.array(np.random.randn(2, 3, 16, 16).astype(np.float32))
+    assert net(x).shape == (2, 10)
+    net.hybridize()
+    assert net(x).shape == (2, 10)
+
+
+def test_batchnorm_moving_stats():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = mx.nd.array((np.random.randn(8, 4, 3, 3) * 3 + 1).astype(np.float32))
+    with autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    rv = bn.running_var.data().asnumpy()
+    assert not np.allclose(rm, 0)
+    assert not np.allclose(rv, 1)
+    # inference mode must not move stats
+    before = rm.copy()
+    bn(x)
+    np.testing.assert_allclose(bn.running_mean.data().asnumpy(), before)
+
+
+def test_conv_transpose():
+    net = nn.Conv2DTranspose(4, kernel_size=4, strides=2, padding=1,
+                             in_channels=3)
+    net.initialize()
+    x = mx.nd.array(np.random.randn(1, 3, 8, 8).astype(np.float32))
+    assert net(x).shape == (1, 4, 16, 16)
+
+
+def test_embedding_block():
+    emb = nn.Embedding(10, 5)
+    emb.initialize()
+    idx = mx.nd.array(np.array([1, 2, 3], np.float32))
+    assert emb(idx).shape == (3, 5)
+
+
+def test_losses_basic():
+    pred = mx.nd.array(np.random.randn(4, 5).astype(np.float32))
+    label_sparse = mx.nd.array(np.array([0, 1, 2, 3], np.float32))
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label_sparse)
+    assert l.shape == (4,)
+    # L2
+    a = mx.nd.array(np.ones((3, 2), np.float32))
+    b = mx.nd.array(np.zeros((3, 2), np.float32))
+    l2 = gluon.loss.L2Loss()(a, b)
+    np.testing.assert_allclose(l2.asnumpy(), np.full(3, 0.5), rtol=1e-6)
+    l1 = gluon.loss.L1Loss()(a, b)
+    np.testing.assert_allclose(l1.asnumpy(), np.ones(3), rtol=1e-6)
+    # BCE matches manual
+    p = mx.nd.array(np.array([[0.5, -0.5]], np.float32))
+    t = mx.nd.array(np.array([[1.0, 0.0]], np.float32))
+    got = gluon.loss.SigmoidBinaryCrossEntropyLoss()(p, t).asnumpy()
+    x = np.array([[0.5, -0.5]])
+    ref = (np.maximum(x, 0) - x * np.array([[1.0, 0.0]])
+           + np.log1p(np.exp(-np.abs(x)))).mean(axis=1)
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_huber_hinge_triplet():
+    pred = mx.nd.array(np.array([[2.0], [0.3]], np.float32))
+    label = mx.nd.array(np.array([[0.0], [0.0]], np.float32))
+    h = gluon.loss.HuberLoss(rho=1)(pred, label).asnumpy()
+    np.testing.assert_allclose(h, [1.5, 0.5 * 0.09], rtol=1e-5)
+    hi = gluon.loss.HingeLoss()(pred, mx.nd.array(np.array([[1.0], [-1.0]],
+                                                           np.float32))).asnumpy()
+    np.testing.assert_allclose(hi, [0.0, 1.3], rtol=1e-5)
+
+
+def test_ctc_loss_matches_simple_case():
+    # T=2, C=3 (blank=0), label "1": paths: (b,1),(1,b),(1,1)
+    logits = np.zeros((2, 1, 3), np.float32)  # uniform → each path (1/3)^2
+    loss = gluon.loss.CTCLoss(layout="TNC")(
+        mx.nd.array(logits), mx.nd.array(np.array([[1]], np.float32)))
+    expected = -np.log(3 * (1 / 9))
+    np.testing.assert_allclose(loss.asnumpy(), [expected], rtol=1e-4)
+
+
+def test_trainer_step_and_state_io():
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    x = mx.nd.array(np.ones((4, 3), np.float32))
+    with autograd.record():
+        L = net(x).sum()
+    L.backward()
+    w_before = net.weight.data().asnumpy().copy()
+    trainer.step(4)
+    assert not np.allclose(net.weight.data().asnumpy(), w_before)
+    trainer.save_states("/tmp/trainer.states")
+    trainer.load_states("/tmp/trainer.states")
+
+
+def test_clip_global_norm():
+    arrays = [mx.nd.array(np.ones((2, 2), np.float32) * 3),
+              mx.nd.array(np.ones((2,), np.float32) * 4)]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert abs(total - 1.0) < 1e-4
+    assert abs(norm - np.sqrt(9 * 4 + 16 * 2)) < 1e-3
+
+
+def test_split_and_load():
+    data = mx.nd.array(np.arange(12).reshape(6, 2).astype(np.float32))
+    slices = gluon.utils.split_and_load(data, [mx.cpu(0), mx.cpu(0)])
+    assert len(slices) == 2
+    np.testing.assert_allclose(slices[0].asnumpy(), data.asnumpy()[:3])
+
+
+def test_block_save_load_params():
+    net = nn.HybridSequential(prefix="ckpt_")
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    y0 = net(mx.nd.ones((1, 3)))
+    net.save_params("/tmp/blk.params")
+    net2 = nn.HybridSequential(prefix="ckpt_")
+    with net2.name_scope():
+        net2.add(nn.Dense(4, in_units=3))
+    net2.load_params("/tmp/blk.params")
+    y1 = net2(mx.nd.ones((1, 3)))
+    np.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(), rtol=1e-6)
+
+
+def test_sequential_getitem_len():
+    net = nn.Sequential()
+    for _ in range(3):
+        net.add(nn.Dense(2))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+
+
+def test_lambda_blocks():
+    net = nn.Sequential()
+    net.add(nn.HybridLambda(lambda F, x: F.Activation(x, act_type="relu")))
+    net.add(nn.Lambda(lambda x: x * 2))
+    x = mx.nd.array(np.array([[-1.0, 2.0]], np.float32))
+    np.testing.assert_allclose(net(x).asnumpy(), [[0.0, 4.0]])
+
+
+def test_dataset_dataloader():
+    X = np.random.randn(10, 3).astype(np.float32)
+    Y = np.arange(10).astype(np.float32)
+    dataset = gluon.data.ArrayDataset(X, Y)
+    loader = gluon.data.DataLoader(dataset, batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 3
+    xb, yb = batches[0]
+    assert xb.shape == (4, 3)
+    np.testing.assert_allclose(yb.asnumpy(), [0, 1, 2, 3])
+    # threaded path
+    loader2 = gluon.data.DataLoader(dataset, batch_size=4, num_workers=2)
+    assert len(list(loader2)) == 3
+
+
+def test_dataset_transform():
+    X = np.ones((4, 2), np.float32)
+    ds = gluon.data.ArrayDataset(X, np.zeros(4, np.float32))
+    ds2 = ds.transform_first(lambda x: x * 3)
+    x, y = ds2[0]
+    np.testing.assert_allclose(np.asarray(x), [3, 3])
+
+
+def test_rnn_cells_and_layers():
+    cell = gluon.rnn.GRUCell(6, input_size=4)
+    cell.initialize()
+    x = mx.nd.array(np.random.randn(2, 5, 4).astype(np.float32))
+    outs, state = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 5, 6)
+
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.LSTMCell(6, input_size=4))
+    stack.add(gluon.rnn.LSTMCell(6, input_size=6))
+    stack.initialize()
+    outs, states = stack.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 5, 6)
+    assert len(states) == 4
+
+    layer = gluon.rnn.GRU(6, num_layers=1, layout="NTC", input_size=4)
+    layer.initialize()
+    out = layer(x)
+    assert out.shape == (2, 5, 6)
+
+
+def test_rnn_layer_vs_cell_consistency():
+    """Fused RNN op must match the unrolled cell math (reference guarantees
+    the same; SURVEY §2.2 RNN row)."""
+    T, N, C, H = 4, 2, 3, 5
+    x = mx.nd.array(np.random.randn(T, N, C).astype(np.float32))
+
+    layer = gluon.rnn.LSTM(H, num_layers=1, layout="TNC", input_size=C)
+    layer.initialize()
+    out_layer = layer(x)
+
+    cell = gluon.rnn.LSTMCell(H, input_size=C)
+    cell.initialize()
+    # copy layer weights into cell
+    cp = {p.name.split("_", 1)[1]: p for p in layer.collect_params().values()}
+    cell.i2h_weight.set_data(cp["l0_i2h_weight"].data())
+    cell.h2h_weight.set_data(cp["l0_h2h_weight"].data())
+    cell.i2h_bias.set_data(cp["l0_i2h_bias"].data())
+    cell.h2h_bias.set_data(cp["l0_h2h_bias"].data())
+    out_cell, _ = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    np.testing.assert_allclose(out_layer.asnumpy(), out_cell.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bidirectional_cell():
+    l_cell = gluon.rnn.LSTMCell(4, input_size=3)
+    r_cell = gluon.rnn.LSTMCell(4, input_size=3)
+    bi = gluon.rnn.BidirectionalCell(l_cell, r_cell)
+    bi.initialize()
+    x = mx.nd.array(np.random.randn(2, 5, 3).astype(np.float32))
+    outs, states = bi.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 5, 8)
+
+
+def test_model_zoo_smoke():
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    x = mx.nd.array(np.random.randn(1, 3, 32, 32).astype(np.float32))
+    net = vision.get_model("resnet18_v1", classes=10, thumbnail=True)
+    net.initialize(mx.init.Xavier())
+    assert net(x).shape == (1, 10)
+    net = vision.get_model("resnet18_v2", classes=10, thumbnail=True)
+    net.initialize(mx.init.Xavier())
+    assert net(x).shape == (1, 10)
+    net = vision.get_model("mobilenet0.25", classes=10)
+    net.initialize(mx.init.Xavier())
+    x224 = mx.nd.array(np.random.randn(1, 3, 224, 224).astype(np.float32))
+    assert net(x224).shape == (1, 10)
+
+
+def test_constant_param():
+    const = gluon.Constant("const", np.array([[1.0, 2.0]], np.float32))
+    const.initialize()
+    np.testing.assert_allclose(const.data().asnumpy(), [[1.0, 2.0]])
+    assert const.grad_req == "null"
+
+
+def test_cast():
+    net = nn.Dense(3, in_units=2)
+    net.initialize()
+    net.cast("bfloat16")
+    assert net.weight.data().dtype == np.dtype("bfloat16")
+    x = mx.nd.array(np.ones((1, 2), np.float32)).astype("bfloat16")
+    assert net(x).dtype == np.dtype("bfloat16")
