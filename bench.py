@@ -1,0 +1,66 @@
+"""Benchmark: ResNet-50 training throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+
+Baseline: the reference's published single-GPU ResNet-50 train number,
+batch 32 — 90.74 img/s on M40 (docs/faq/perf.md:174; the K80 row is 45.52).
+We benchmark the same workload (ResNet-50, batch 32, synthetic ImageNet
+shapes) as one fused XLA train step (forward+loss+backward+SGD update) via
+parallel.DataParallelTrainer on whatever single chip is available.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 90.74  # M40, ResNet-50 train batch 32 (docs/faq/perf.md:174)
+
+
+def main():
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    from incubator_mxnet_tpu.parallel import make_mesh, DataParallelTrainer
+
+    batch = 32
+    mx.random.seed(0)
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+
+    mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+    trainer = DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        optimizer="sgd", optimizer_params={"learning_rate": 0.1,
+                                           "momentum": 0.9},
+        mesh=mesh)
+
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.rand(batch, 3, 224, 224).astype(np.float32))
+    y = mx.nd.array((rs.rand(batch) * 1000).astype(np.float32))
+
+    # warmup (compile)
+    for _ in range(2):
+        trainer.step(x, y).block_until_ready()
+
+    n_steps = int(os.environ.get("BENCH_STEPS", "10"))
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = trainer.step(x, y)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    img_s = n_steps * batch / dt
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_per_chip",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,12 @@ from . import autograd
 from . import random
 from . import random_state
 
+from . import attribute
+from .attribute import AttrScope
+from . import symbol
+from . import symbol as sym  # canonical alias, as in mxnet
+from .symbol import Symbol
+
 from . import lr_scheduler
 from . import optimizer
 from . import optimizer as opt  # alias, as in mxnet
@@ -39,5 +45,11 @@ from . import kvstore as kv  # alias, as in mxnet
 from . import io
 from . import recordio
 from . import image
+from . import metric
+from . import callback
+from . import monitor
+from . import module
+from . import module as mod  # alias, as in mxnet
+from . import model
 from . import gluon
 from . import parallel

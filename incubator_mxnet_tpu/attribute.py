@@ -1,0 +1,55 @@
+"""AttrScope: scoped symbol attributes (ref: python/mxnet/attribute.py).
+
+``with mx.AttrScope(ctx_group='dev1'):`` attaches attributes to every Symbol
+created inside — the mechanism behind `ctx_group` model parallelism
+(example/model-parallel/lstm/lstm.py:65; PlaceDevice pass
+src/executor/graph_executor.cc:406). On TPU, ctx_group attrs translate to
+sharding annotations (parallel package) rather than device copies.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current"]
+
+_current = threading.local()
+
+
+class AttrScope(object):
+    """ref: attribute.py class AttrScope."""
+
+    def __init__(self, **kwargs):
+        self._old_scope = None
+        for value in kwargs.values():
+            if not isinstance(value, str):
+                raise ValueError("Attributes need to be a string")
+        self._attr = kwargs
+
+    def get(self, attr):
+        """Merge scope attrs with user attrs (ref: attribute.py get)."""
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+    def __enter__(self):
+        if not hasattr(_current, "value"):
+            _current.value = AttrScope()
+        self._old_scope = _current.value
+        attr = _current.value._attr.copy()
+        attr.update(self._attr)
+        self._attr = attr
+        _current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old_scope
+        _current.value = self._old_scope
+
+
+def current():
+    if not hasattr(_current, "value"):
+        _current.value = AttrScope()
+    return _current.value

@@ -165,7 +165,7 @@ class Block(object):
             assert name not in self._reg_params or self._reg_params[name] is value, \
                 "Overriding Parameter attribute %s is not allowed. " \
                 "If you want to share parameters between blocks, please set " \
-                "'params' at Block construction instead."
+                "'params' at Block construction instead." % name
             self._reg_params[name] = value
         super().__setattr__(name, value)
 
@@ -275,16 +275,16 @@ class CachedOp(object):
     def __init__(self, block):
         self.block = block
         self._cache = {}
+        # the param set only changes on structural mutation, which calls
+        # _clear_cached_op (→ a fresh CachedOp); cache the walk here
+        self._params = block._active_params
+        self._param_names = sorted(self._params.keys())
 
     def _make_fn(self, param_names, n_inputs, in_fmt, train):
         block = self.block
 
         def fn(param_vals, input_vals, rng):
-            shadows = {}
-            params = block._active_params
-            for name in param_names:
-                p = params[name]
-                shadows[name] = NDArray(param_vals[name])
+            shadows = {name: NDArray(param_vals[name]) for name in param_names}
             nd_in = [None if v is None else NDArray(v) for v in input_vals]
             args, _ = _regroup(nd_in, in_fmt)
             if not isinstance(args, list):
@@ -307,8 +307,8 @@ class CachedOp(object):
     def __call__(self, *args):
         block = self.block
         flat_args, in_fmt = _flatten(args, "input")
-        params = block._active_params
-        param_names = sorted(params.keys())
+        params = self._params
+        param_names = self._param_names
         param_vals = {}
         for name in param_names:
             p = params[name]
@@ -408,7 +408,9 @@ class HybridBlock(Block):
 
     def __setattr__(self, name, value):
         super().__setattr__(name, value)
-        if isinstance(value, HybridBlock):
+        if isinstance(value, (HybridBlock, Parameter)):
+            # a new child OR a new Parameter invalidates the traced graph —
+            # the CachedOp snapshots the param set at construction
             self._clear_cached_op()
 
     def _clear_cached_op(self):

@@ -421,6 +421,9 @@ class ImageIter(io_mod.DataIter):
                                                          path_imgrec, "r")
                 self.seq = list(self.imgrec.keys)
             else:
+                assert not shuffle and num_parts <= 1, \
+                    "path_imgidx is required when shuffle or num_parts > 1 " \
+                    "is used with a .rec file (ref: image.py:1115)"
                 self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
         if path_imglist:
             with open(path_imglist) as fin:

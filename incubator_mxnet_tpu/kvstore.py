@@ -105,12 +105,17 @@ class KVStore(object):
             red = self._reduce(vlist)
             if self._compressor is not None:
                 red = self._compressor.compress(k, red)
+            red = self._cross_worker_reduce(red)
             if self._updater is not None:
                 self._updater(_int_key(k), red, self._store[k])
             else:
                 # no updater: store holds the reduced value (ref:
                 # kvstore_local.h PushImpl assigns local = merged)
                 self._store[k]._write(red._read().astype(self._store[k].dtype))
+
+    def _cross_worker_reduce(self, red):
+        """Hook for the dist subclasses: sum across workers. No-op locally."""
+        return red
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast store value into out list (ref: KVStore::Pull)."""

@@ -1,0 +1,130 @@
+"""Legacy model API: checkpoint helpers + FeedForward.
+
+ref: python/mxnet/model.py (995 LoC) — ``save_checkpoint``/``load_checkpoint``
+(:366,396) write ``prefix-symbol.json`` + ``prefix-####.params``, the format
+every MXNet deployment pipeline consumes; ``FeedForward`` is the deprecated
+high-level trainer kept for script compatibility (it delegates to Module).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from . import ndarray as nd
+from . import symbol as sym_mod
+from .base import MXNetError
+
+__all__ = ["save_checkpoint", "load_checkpoint", "FeedForward",
+           "BatchEndParam"]
+
+from .module.base_module import BatchEndParam  # re-export for parity
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """ref: model.py:366 save_checkpoint."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    logging.info('Saved checkpoint to "%s"', param_name)
+
+
+def load_checkpoint(prefix, epoch):
+    """ref: model.py:396 load_checkpoint → (symbol, arg_params, aux_params)."""
+    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        if tp == "aux":
+            aux_params[name] = v
+    return (symbol, arg_params, aux_params)
+
+
+class FeedForward(object):
+    """Deprecated high-level model (ref: model.py class FeedForward).
+    Kept as a thin shim over Module for old scripts."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        import warnings
+        warnings.warn("FeedForward is deprecated. Please use Module instead.",
+                      DeprecationWarning, stacklevel=2)
+        from . import initializer as init_mod
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.optimizer = optimizer
+        self.initializer = initializer if initializer is not None \
+            else init_mod.Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    def _make_module(self, data_iter):
+        from .module import Module
+        label_names = [n for n in self.symbol.list_arguments()
+                       if n.endswith("label")]
+        data_names = [d.name for d in data_iter.provide_data]
+        self._module = Module(self.symbol, data_names=data_names,
+                              label_names=label_names, context=self.ctx)
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        """ref: model.py FeedForward.fit → Module.fit."""
+        from .io import NDArrayIter
+        if isinstance(X, (np.ndarray, nd.NDArray)):
+            X = NDArrayIter(X, y, batch_size=self.numpy_batch_size)
+        self._make_module(X)
+        self._module.fit(X, eval_data=eval_data, eval_metric=eval_metric,
+                         epoch_end_callback=epoch_end_callback,
+                         batch_end_callback=batch_end_callback,
+                         kvstore=kvstore, optimizer=self.optimizer,
+                         optimizer_params=self.kwargs,
+                         initializer=self.initializer,
+                         arg_params=self.arg_params,
+                         aux_params=self.aux_params,
+                         begin_epoch=self.begin_epoch,
+                         num_epoch=self.num_epoch, monitor=monitor)
+        self.arg_params, self.aux_params = self._module.get_params()
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        """ref: model.py FeedForward.predict."""
+        from .io import NDArrayIter
+        if isinstance(X, (np.ndarray, nd.NDArray)):
+            X = NDArrayIter(X, batch_size=self.numpy_batch_size)
+        if self._module is None:
+            self._make_module(X)
+            self._module.bind(X.provide_data, X.provide_label,
+                              for_training=False)
+            self._module.set_params(self.arg_params, self.aux_params or {})
+        out = self._module.predict(X, num_batch=num_batch, reset=reset)
+        return out.asnumpy() if hasattr(out, "asnumpy") else out
+
+    def save(self, prefix, epoch=None):
+        """ref: model.py FeedForward.save."""
+        if epoch is None:
+            epoch = self.num_epoch
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params or {},
+                        self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        """ref: model.py FeedForward.load."""
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)

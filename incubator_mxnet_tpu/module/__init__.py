@@ -1,0 +1,5 @@
+"""Module API (ref: python/mxnet/module/__init__.py)."""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+from .executor_group import DataParallelExecutorGroup

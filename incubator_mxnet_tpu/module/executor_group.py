@@ -1,0 +1,205 @@
+"""DataParallelExecutorGroup (ref: python/mxnet/module/executor_group.py).
+
+Splits each batch across contexts, one Executor per context, gradient
+aggregation hooks for the update path (ref: executor_group.py:129,267,422).
+On a TPU mesh the fused path is parallel.DataParallelTrainer; this class
+keeps the Module API's multi-context contract (slices over logical
+devices — useful on the virtual CPU mesh and for ported scripts).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from .. import ndarray as nd
+from ..io import DataDesc
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """ref: executor_group.py _split_input_slice / decide_slices."""
+    total = sum(work_load_list)
+    slices = []
+    start = 0
+    for i, w in enumerate(work_load_list):
+        if i == len(work_load_list) - 1:
+            end = batch_size
+        else:
+            end = start + int(round(batch_size * w / total))
+        slices.append(slice(start, end))
+        start = end
+    return slices
+
+
+class DataParallelExecutorGroup(object):
+    """ref: executor_group.py class DataParallelExecutorGroup."""
+
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad, shared_group=None,
+                 logger=None, fixed_param_names=None, grad_req="write",
+                 state_names=None):
+        self.param_names = param_names
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload or [1] * len(contexts)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = fixed_param_names or []
+        self.grad_req = grad_req
+        self.shared_group = shared_group
+
+        self.batch_size = None
+        self.slices = None
+        self.execs = []
+        self.data_shapes = None
+        self.label_shapes = None
+        self.data_names = None
+        self.label_names = None
+        self.output_layouts = None
+        self.num_outputs = None
+
+        self.bind_exec(data_shapes, label_shapes, shared_group)
+
+    def bind_exec(self, data_shapes, label_shapes, shared_group=None,
+                  reshape=False):
+        """Bind one executor per context (ref: executor_group.py bind_exec)."""
+        self.batch_size = data_shapes[0][1][0]
+        self.slices = _split_input_slice(self.batch_size, self.workload)
+        self.data_shapes = [DataDesc(*ds) if not isinstance(ds, DataDesc)
+                            else ds for ds in data_shapes]
+        self.data_names = [ds.name for ds in self.data_shapes]
+        if label_shapes is not None:
+            self.label_shapes = [DataDesc(*ls) if not isinstance(ls, DataDesc)
+                                 else ls for ls in label_shapes]
+            self.label_names = [ls.name for ls in self.label_shapes]
+        else:
+            self.label_shapes = None
+            self.label_names = []
+
+        self.execs = []
+        for i, ctx in enumerate(self.contexts):
+            n_i = self.slices[i].stop - self.slices[i].start
+            shapes = {}
+            for ds in self.data_shapes:
+                shapes[ds.name] = (n_i,) + tuple(ds.shape[1:])
+            if self.label_shapes:
+                for ls in self.label_shapes:
+                    shapes[ls.name] = (n_i,) + tuple(ls.shape[1:])
+            grad_req = {}
+            for name in self.arg_names:
+                if not self.for_training or name in self.fixed_param_names or \
+                        name in shapes:  # data/label get no grads by default
+                    if name in shapes and self.inputs_need_grad and \
+                            name in self.data_names:
+                        grad_req[name] = "write"
+                    else:
+                        grad_req[name] = "null"
+                else:
+                    grad_req[name] = self.grad_req if isinstance(self.grad_req, str) \
+                        else self.grad_req.get(name, "write")
+            shared_exec = shared_group.execs[i] if shared_group else None
+            exe = self.symbol.simple_bind(ctx=ctx, grad_req=grad_req,
+                                          shared_exec=shared_exec, **shapes)
+            self.execs.append(exe)
+        self.num_outputs = len(self.symbol.list_outputs())
+
+    def reshape(self, data_shapes, label_shapes):
+        """ref: executor_group.py reshape."""
+        self.bind_exec(data_shapes, label_shapes, self.shared_group,
+                       reshape=True)
+
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        """ref: executor_group.py set_params."""
+        for exe in self.execs:
+            exe.copy_params_from(arg_params, aux_params,
+                                 allow_extra_params=allow_extra)
+
+    def get_params(self, arg_params, aux_params):
+        """Average params over devices into the given dicts
+        (ref: executor_group.py get_params)."""
+        for name in self.param_names:
+            arrs = [exe.arg_dict[name] for exe in self.execs]
+            acc = arrs[0].asnumpy().astype(np.float64)
+            for a in arrs[1:]:
+                acc += a.asnumpy()
+            arg_params[name] = nd.array((acc / len(arrs)).astype(
+                arrs[0].dtype))
+        for name in self.aux_names:
+            arrs = [exe.aux_dict[name] for exe in self.execs]
+            acc = arrs[0].asnumpy().astype(np.float64)
+            for a in arrs[1:]:
+                acc += a.asnumpy()
+            aux_params[name] = nd.array((acc / len(arrs)).astype(
+                arrs[0].dtype))
+
+    def forward(self, data_batch, is_train=None):
+        """Slice the batch per context and run (ref: executor_group.py:422)."""
+        if is_train is None:
+            is_train = self.for_training
+        for i, exe in enumerate(self.execs):
+            sl = self.slices[i]
+            feed = {}
+            for name, arr in zip(self.data_names, data_batch.data):
+                feed[name] = arr[sl.start:sl.stop]
+            if self.label_names and data_batch.label:
+                for name, arr in zip(self.label_names, data_batch.label):
+                    feed[name] = arr[sl.start:sl.stop]
+            exe.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        """ref: executor_group.py backward."""
+        assert self.for_training, "re-bind with for_training=True to run backward"
+        for i, exe in enumerate(self.execs):
+            og = None
+            if out_grads is not None:
+                sl = self.slices[i]
+                og = [g[sl.start:sl.stop] for g in out_grads]
+            exe.backward(out_grads=og)
+
+    def get_outputs(self, merge_multi_context=True):
+        """ref: executor_group.py get_outputs."""
+        outputs = [[exe.outputs[i] for exe in self.execs]
+                   for i in range(self.num_outputs)]
+        if merge_multi_context:
+            return [nd.ndarray.concatenate(parts, axis=0) if len(parts) > 1
+                    else parts[0] for parts in outputs]
+        return outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        """ref: executor_group.py get_input_grads."""
+        assert self.inputs_need_grad
+        grads = [[exe.grad_dict[name] for exe in self.execs]
+                 for name in self.data_names]
+        if merge_multi_context:
+            return [nd.ndarray.concatenate(parts, axis=0) if len(parts) > 1
+                    else parts[0] for parts in grads]
+        return grads
+
+    def update_metric(self, eval_metric, labels):
+        """ref: executor_group.py update_metric."""
+        for i, exe in enumerate(self.execs):
+            sl = self.slices[i]
+            labels_slice = [label[sl.start:sl.stop] for label in labels]
+            eval_metric.update(labels_slice, exe.outputs)
+
+    @property
+    def grad_arrays(self):
+        """grad arrays grouped per param then per device."""
+        return [[exe.grad_dict.get(name) for exe in self.execs]
+                for name in self.param_names]
+
+    @property
+    def param_arrays(self):
+        return [[exe.arg_dict[name] for exe in self.execs]
+                for name in self.param_names]
+
+    @property
+    def aux_arrays(self):
+        return [[exe.aux_dict[name] for exe in self.execs]
+                for name in self.aux_names]
+
+    def set_monitor_callback(self, callback):
+        for exe in self.execs:
+            exe.set_monitor_callback(callback)

@@ -20,6 +20,41 @@ from jax import lax
 from .registry import register
 
 
+def _fc_param_shapes(data_shape, params):
+    """ref: fully_connected.cc FInferShape fills weight/bias from data."""
+    nh = params.get("num_hidden", 0)
+    flatten = params.get("flatten", True)
+    in_units = int(np.prod(data_shape[1:])) if flatten else data_shape[-1]
+    return {"weight": (nh, in_units), "bias": (nh,)}
+
+
+def _conv_param_shapes(data_shape, params):
+    """ref: convolution.cc FInferShape."""
+    nf = params.get("num_filter", 0)
+    ng = params.get("num_group", 1)
+    kernel = tuple(params.get("kernel", ()))
+    return {"weight": (nf, data_shape[1] // ng) + kernel, "bias": (nf,)}
+
+
+def _deconv_param_shapes(data_shape, params):
+    """ref: deconvolution-inl.h — weight is (in, out/groups, *k)."""
+    nf = params.get("num_filter", 0)
+    ng = params.get("num_group", 1)
+    kernel = tuple(params.get("kernel", ()))
+    return {"weight": (data_shape[1], nf // ng) + kernel, "bias": (nf,)}
+
+
+def _channel_param_shapes(data_shape, params):
+    c = data_shape[params.get("axis", 1) % len(data_shape)]
+    return {"gamma": (c,), "beta": (c,), "moving_mean": (c,),
+            "moving_var": (c,)}
+
+
+def _layernorm_param_shapes(data_shape, params):
+    c = data_shape[params.get("axis", -1) % len(data_shape)]
+    return {"gamma": (c,), "beta": (c,)}
+
+
 def _pair(v, n=2):
     if isinstance(v, (tuple, list)):
         return tuple(v)
@@ -30,7 +65,9 @@ def _pair(v, n=2):
 # FullyConnected (ref: src/operator/nn/fully_connected.cc)
 # ---------------------------------------------------------------------------
 
-@register("FullyConnected", num_inputs=None)
+@register("FullyConnected", num_inputs=None,
+          input_names=("data", "weight", "bias"),
+          finfer_params=_fc_param_shapes)
 def _fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False, flatten=True):
     """y = x·Wᵀ + b on the MXU (ref: fully_connected.cc:1)."""
     x = data.reshape((data.shape[0], -1)) if flatten else data
@@ -44,7 +81,9 @@ def _fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False, flatt
 # Convolution / Deconvolution (ref: src/operator/nn/convolution.cc:383-509)
 # ---------------------------------------------------------------------------
 
-@register("Convolution", num_inputs=None)
+@register("Convolution", num_inputs=None,
+          input_names=("data", "weight", "bias"),
+          finfer_params=_conv_param_shapes)
 def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
                  num_filter=0, num_group=1, no_bias=False, workspace=1024,
                  cudnn_tune=None, cudnn_off=False, layout=None):
@@ -71,7 +110,9 @@ def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(
     return out
 
 
-@register("Deconvolution", num_inputs=None)
+@register("Deconvolution", num_inputs=None,
+          input_names=("data", "weight", "bias"),
+          finfer_params=_deconv_param_shapes)
 def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
                    adj=(), target_shape=(), num_filter=0, num_group=1, no_bias=True,
                    workspace=512, cudnn_tune=None, cudnn_off=False, layout=None):
@@ -176,6 +217,9 @@ def _upsampling(*args, scale=1, sample_type="nearest", num_args=1, num_filter=0,
 
 @register("BatchNorm", num_inputs=5, num_outputs=3, num_visible_outputs=1,
           takes_is_train=True, nograd_inputs=(3, 4), aliases=("BatchNorm_v1",),
+          input_names=("data", "gamma", "beta", "moving_mean", "moving_var"),
+          aux_input_names=("moving_mean", "moving_var"),
+          finfer_params=_channel_param_shapes,
           fvisible=lambda params, n: n if params.get("output_mean_var") else 1)
 def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
                 fix_gamma=True, use_global_stats=False, output_mean_var=False,
@@ -197,7 +241,8 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0
     return out, mean, var
 
 
-@register("LayerNorm", num_inputs=3)
+@register("LayerNorm", num_inputs=3, input_names=("data", "gamma", "beta"),
+          finfer_params=_layernorm_param_shapes)
 def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
     """ref: src/operator/nn/layer_norm.cc"""
     mean = jnp.mean(data, axis=axis, keepdims=True)
@@ -208,7 +253,9 @@ def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
     return out * gamma.reshape(bshape) + beta.reshape(bshape)
 
 
-@register("InstanceNorm", num_inputs=3)
+@register("InstanceNorm", num_inputs=3,
+          input_names=("data", "gamma", "beta"),
+          finfer_params=lambda ds, p: {"gamma": (ds[1],), "beta": (ds[1],)})
 def _instance_norm(data, gamma, beta, eps=1e-3):
     """ref: src/operator/instance_norm.cc"""
     red = tuple(range(2, data.ndim))
@@ -248,7 +295,9 @@ def _activation(data, act_type="relu"):
     raise ValueError("unknown act_type %r" % act_type)
 
 
-@register("LeakyReLU", num_inputs=None, needs_rng=True, takes_is_train=True)
+@register("LeakyReLU", num_inputs=None, needs_rng=True, takes_is_train=True,
+          fargnames=lambda p: ("data", "gamma") if p.get("act_type") == "prelu"
+          else ("data",))
 def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125,
                 upper_bound=0.334, rng=None, is_train=False):
     """ref: src/operator/leaky_relu.cc (leaky/elu/prelu/rrelu)."""

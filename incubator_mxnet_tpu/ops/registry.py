@@ -64,7 +64,8 @@ class Operator:
                  num_outputs: int = 1, num_visible_outputs: Optional[int] = None,
                  differentiable: bool = True, needs_rng: bool = False,
                  takes_is_train: bool = False, nograd_inputs=(), mutate_inputs=(),
-                 input_names=None, fvisible=None, doc: str = ""):
+                 input_names=None, aux_input_names=(), fargnames=None,
+                 finfer_params=None, fvisible=None, doc: str = ""):
         self.name = name
         self.fcompute = fcompute
         self.num_inputs = num_inputs
@@ -77,9 +78,39 @@ class Operator:
         self.nograd_inputs = tuple(nograd_inputs)
         self.mutate_inputs = tuple(mutate_inputs)
         self.input_names = input_names
+        self.aux_input_names = tuple(aux_input_names)
+        self.fargnames = fargnames
+        self.finfer_params = finfer_params
         self.fvisible = fvisible
         self.doc = doc
         self._jit_cache: dict = {}
+
+    def arg_names(self, params: dict):
+        """Required input names given static params, or None if unnamed
+        (parity: FListInputNames, which ConvolutionParam et al. vary by
+        no_bias — include/mxnet/op_attr_types.h). Falls back to the
+        fcompute's own default for no_bias (Deconvolution defaults True)."""
+        if self.fargnames is not None:
+            return list(self.fargnames(params))
+        if self.input_names is None:
+            return None
+        names = list(self.input_names)
+        if "bias" in names:
+            no_bias = params.get("no_bias", self._param_default("no_bias"))
+            if no_bias:
+                names.remove("bias")
+        return names
+
+    def _param_default(self, pname):
+        if not hasattr(self, "_defaults"):
+            import inspect
+            try:
+                sig = inspect.signature(self.fcompute)
+                self._defaults = {k: v.default for k, v in sig.parameters.items()
+                                  if v.default is not inspect.Parameter.empty}
+            except (TypeError, ValueError):
+                self._defaults = {}
+        return self._defaults.get(pname)
 
     def visible_outputs(self, params: dict, n_outputs: int) -> int:
         """How many of ``n_outputs`` are user-visible (rest are aux, e.g.

@@ -220,7 +220,10 @@ def _pick(data, index, axis=1, keepdims=False):
     return out
 
 
-@register("Embedding", num_inputs=2, nograd_inputs=(0,))
+@register("Embedding", num_inputs=2, nograd_inputs=(0,),
+          input_names=("data", "weight"),
+          finfer_params=lambda ds, p: {"weight": (p.get("input_dim", 0),
+                                                  p.get("output_dim", 0))})
 def _embedding(data, weight, input_dim=0, output_dim=0, dtype="float32", sparse_grad=False):
     """ref: indexing_op.cc Embedding — gather rows of weight.
 

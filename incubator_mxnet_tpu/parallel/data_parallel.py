@@ -106,12 +106,12 @@ class DataParallelTrainer(object):
         self._jit_cache = {}
 
     # -- parameter plumbing ------------------------------------------------
-    def _gather_params(self, *example_args):
+    def _gather_params(self, example_x):
         blk_params = self.block.collect_params()
         for p in blk_params.values():
             if p._data is None and p._deferred_init:
-                # resolve deferred shapes with one eager pass
-                self.block._run_deferred_init(*example_args)
+                # resolve deferred shapes with one eager pass over the data
+                self.block._run_deferred_init(NDArray(example_x))
                 break
         repl = NamedSharding(self.mesh, P())
         self._params = {}
@@ -171,7 +171,7 @@ class DataParallelTrainer(object):
     def compile(self, *example_args):
         """Build + jit the step for the example shapes; returns the jitted fn."""
         if self._params is None:
-            self._gather_params(*example_args)
+            self._gather_params(example_args[0])
         key = tuple((tuple(a.shape), str(a.dtype)) for a in example_args)
         if key not in self._jit_cache:
             repl = NamedSharding(self.mesh, P())
@@ -189,10 +189,16 @@ class DataParallelTrainer(object):
         x = data._read() if isinstance(data, NDArray) else jnp.asarray(data)
         y = label._read() if isinstance(label, NDArray) else jnp.asarray(label)
         fn = self.compile(x, y)
-        rng = random_state.next_key()
+        # shard the batch onto the mesh (H2D + slice per device); params are
+        # already mesh-resident from _gather_params / the previous step
+        batch_sh = NamedSharding(self.mesh, P("dp"))
+        repl = NamedSharding(self.mesh, P())
+        x = jax.device_put(x, batch_sh)
+        y = jax.device_put(y, batch_sh)
+        rng = jax.device_put(random_state.next_key(), repl)
         self._params, self._opt_state, loss_val = fn(
             self._params, self._opt_state, x, y, rng,
-            jnp.asarray(self._lr, jnp.float32))
+            jax.device_put(jnp.asarray(self._lr, jnp.float32), repl))
         return loss_val
 
     @property

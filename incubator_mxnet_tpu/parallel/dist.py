@@ -82,27 +82,16 @@ class DistKVStore(KVStore):
                 "(equivalent to dist_sync). See SURVEY.md §2.4.")
         init_process()
 
-    def push(self, key, value, priority=0):
-        """Reduce locally, compress, then all-reduce across workers.
-
-        Compression runs BEFORE the cross-worker exchange — that is its whole
-        point (worker-side quantize, server-side dequant+sum, ref:
-        gradient_compression.h); the 2-bit values sum exactly because each is
-        in {-t, 0, +t}."""
-        keys, values = self._normalize(key, value)
-        for k, vlist in zip(keys, values):
-            red = self._reduce(vlist)
-            if self._compressor is not None:
-                red = self._compressor.compress(k, red)
-            if num_workers() > 1:
-                from jax.experimental import multihost_utils
-                summed = multihost_utils.process_allgather(red._read())
-                red._write(summed.sum(axis=0))
-            from ..kvstore import _int_key
-            if self._updater is not None:
-                self._updater(_int_key(k), red, self._store[k])
-            else:
-                self._store[k]._write(red._read().astype(self._store[k].dtype))
+    def _cross_worker_reduce(self, red):
+        """Sum across workers over DCN/ICI (base push calls this AFTER local
+        reduce + compression — worker-side quantize before the wire, the
+        point of the scheme, ref: gradient_compression.h; 2-bit values in
+        {-t,0,+t} sum exactly)."""
+        if num_workers() > 1:
+            from jax.experimental import multihost_utils
+            summed = multihost_utils.process_allgather(red._read())
+            red._write(summed.sum(axis=0))
+        return red
 
     def set_optimizer(self, optimizer):
         """dist path: pickle round-trip, as the reference ships the optimizer

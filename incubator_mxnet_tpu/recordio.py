@@ -66,11 +66,17 @@ class MXRecordIO(object):
         d = dict(self.__dict__)
         d["is_open"] = is_open
         d.pop("handle", None)
+        d.pop("_lock", None)
+        d.pop("fidx", None)
         return d
 
     def __setstate__(self, d):
+        import threading
         self.__dict__ = d
         self.handle = None
+        if "idx_path" in d:
+            self._lock = threading.Lock()
+            self.fidx = None
         is_open = d.get("is_open", False)
         self.is_open = False
         if is_open:
@@ -136,11 +142,15 @@ class MXIndexedRecordIO(MXRecordIO):
     MXIndexedRecordIO; idx format: "key\\tposition\\n")."""
 
     def __init__(self, idx_path, uri, flag, key_type=int):
+        import threading
         self.idx_path = idx_path
         self.idx = {}
         self.keys = []
         self.key_type = key_type
         self.fidx = None
+        # seek+read must be atomic under the threaded DataLoader (the
+        # reference used per-process handles; we share one handle + a lock)
+        self._lock = threading.Lock()
         super().__init__(uri, flag)
 
     def open(self):
@@ -171,9 +181,10 @@ class MXIndexedRecordIO(MXRecordIO):
         self.handle.seek(pos)
 
     def read_idx(self, idx):
-        """ref: recordio.py read_idx."""
-        self.seek(idx)
-        return self.read()
+        """ref: recordio.py read_idx (thread-safe: seek+read is atomic)."""
+        with self._lock:
+            self.seek(idx)
+            return self.read()
 
     def write_idx(self, idx, buf):
         """ref: recordio.py write_idx."""

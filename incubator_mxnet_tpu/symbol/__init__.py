@@ -1,0 +1,55 @@
+"""`sym` namespace: Symbol + one generated function per operator.
+
+Parity surface: python/mxnet/symbol/__init__.py.
+"""
+from __future__ import annotations
+
+import sys
+
+from .symbol import Symbol, var, Variable, Group, load, load_json
+from .executor import Executor
+from . import register as _register
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    from ..ops.registry import get_op
+    from .symbol import _make_node
+    return _make_node(get_op("zeros"), [],
+                      {"shape": tuple(shape) if not isinstance(shape, int)
+                       else (shape,), "dtype": dtype})
+
+
+def ones(shape, dtype="float32", **kwargs):
+    from ..ops.registry import get_op
+    from .symbol import _make_node
+    return _make_node(get_op("ones"), [],
+                      {"shape": tuple(shape) if not isinstance(shape, int)
+                       else (shape,), "dtype": dtype})
+
+
+def trace_to_symbol(x):
+    """Build a Symbol from an NDArray's autograd history (used by
+    autograd.get_symbol; ref: c_api MXAutogradGetSymbol)."""
+    from .. import autograd
+    from .symbol import _make_node
+    node_of = {}
+
+    def build(arr):
+        if id(arr) in node_of:
+            return node_of[id(arr)]
+        ref = getattr(arr, "_tape_ref", None)
+        if ref is None:
+            v = var("data%d" % len(node_of))
+            node_of[id(arr)] = v
+            return v
+        tape_node, out_idx = ref
+        ins = [build(a) for a in tape_node.inputs]
+        node = _make_node(tape_node.op, ins, {})
+        out = node if node.num_outputs == 1 else node[out_idx]
+        node_of[id(arr)] = out
+        return out
+
+    return build(x)
+
+
+_register.populate(sys.modules[__name__].__dict__)

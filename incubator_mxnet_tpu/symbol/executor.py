@@ -1,0 +1,230 @@
+"""Executor: compiled execution of a bound Symbol graph.
+
+TPU-native rebirth of src/executor/graph_executor.cc + include/mxnet/executor.h:
+
+* ``bind`` → one jitted XLA program per (shapes, is_train) signature; the
+  reference's memory planning / inplace / segment-bulking passes
+  (graph_executor.cc:903,1341) are XLA's job now.
+* ``forward(is_train)`` / ``backward(out_grads)`` keep MXNet's contract:
+  outputs appear in ``exec.outputs``, gradients accumulate into the bound
+  ``args_grad`` arrays honoring ``grad_req`` write/add/null
+  (kWriteTo/kAddTo of the reference).
+* The backward pass is the jax.vjp of the same traced function — built once
+  and cached, mirroring how GraphExecutor materializes the full fwd+bwd
+  graph at bind time (graph_executor.cc:277).
+* ``set_monitor_callback`` taps every node output (monitor path,
+  graph_executor.cc:121).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray import NDArray
+from .. import ndarray as nd
+from .. import random_state, autograd
+
+__all__ = ["Executor"]
+
+
+class Executor(object):
+    """ref: include/mxnet/executor.h Executor."""
+
+    def __init__(self, symbol, ctx, args, args_grad, grad_req, aux_states):
+        self._symbol = symbol
+        self._ctx = ctx if ctx is not None else current_context()
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self.arg_dict = dict(args)
+        self.grad_dict = dict(args_grad) if args_grad else {}
+        self.aux_dict = dict(aux_states) if aux_states else {}
+        if isinstance(grad_req, str):
+            self.grad_req = {n: grad_req for n in self._arg_names}
+        else:
+            self.grad_req = dict(grad_req)
+        self.arg_arrays = [self.arg_dict[n] for n in self._arg_names]
+        self.grad_arrays = [self.grad_dict.get(n) for n in self._arg_names]
+        self.aux_arrays = [self.aux_dict[n] for n in self._aux_names]
+        self.outputs = []
+        self._monitor_callback = None
+        self._fwd_cache = {}
+        self._vjp = None
+        self._last_sig = None
+
+    # -- compiled graph function ------------------------------------------
+    def _graph_fn(self, is_train):
+        """Pure function (arg_vals, aux_vals, rng) -> (outputs, aux_out)."""
+        symbol = self._symbol
+        monitor = self._monitor_callback
+
+        def fn(arg_vals, aux_vals, rng):
+            from ..ndarray.ndarray import invoke
+            values = {}
+            values.update({n: NDArray(v) for n, v in arg_vals.items()})
+            values.update({n: NDArray(v) for n, v in aux_vals.items()})
+            cache = {}
+            with random_state.use_key(rng):
+                with autograd._scope(recording=False, training=is_train):
+                    for node_ in symbol._topo():
+                        if node_.is_variable():
+                            if node_.name not in values:
+                                raise MXNetError("executor: input %s not bound"
+                                                 % node_.name)
+                            cache[id(node_)] = [values[node_.name]]
+                            continue
+                        ins = []
+                        for i in node_._inputs:
+                            vals = cache[id(i._base())]
+                            ins.append(vals[min(i._out_index or 0,
+                                                len(vals) - 1)])
+                        out = invoke(node_._op, ins, dict(node_._params))
+                        outs = out if isinstance(out, list) else [out]
+                        cache[id(node_)] = outs
+                        if monitor is not None:
+                            for oi, o in enumerate(outs):
+                                monitor("%s_output%d" % (node_._name, oi), o)
+            results = []
+            for r in symbol._roots():
+                vals = cache[id(r._base())]
+                results.append(vals[min(r._out_index or 0, len(vals) - 1)])
+            out_vals = tuple(o._read() for o in results)
+            # aux states that were written in place during the trace
+            aux_out = {n: values[n]._read() for n in aux_vals
+                       if values[n]._version > 0}
+            return out_vals, aux_out
+
+        return fn
+
+    def _signature(self, is_train):
+        return (tuple((n, tuple(self.arg_dict[n].shape),
+                       str(self.arg_dict[n].dtype)) for n in self._arg_names),
+                bool(is_train))
+
+    def forward(self, is_train=False, **kwargs):
+        """ref: executor.h Forward / graph_executor.cc:81."""
+        for name, val in kwargs.items():
+            if name not in self.arg_dict:
+                raise TypeError("Unknown argument %s" % name)
+            self.arg_dict[name]._write(
+                val._read().astype(self.arg_dict[name].dtype)
+                if isinstance(val, NDArray)
+                else jnp.asarray(np.asarray(val),
+                                 self.arg_dict[name]._read().dtype))
+        sig = self._signature(is_train)
+        entry = self._fwd_cache.get(sig)
+        if entry is None:
+            raw = self._graph_fn(is_train)
+            entry = {"raw": raw,
+                     "jit": jax.jit(raw) if self._monitor_callback is None
+                     else raw}
+            self._fwd_cache[sig] = entry
+        arg_vals = {n: self.arg_dict[n]._read() for n in self._arg_names}
+        aux_vals = {n: self.aux_dict[n]._read() for n in self._aux_names}
+        rng = random_state.next_key()
+        out_vals, aux_out = entry["jit"](arg_vals, aux_vals, rng)
+        for n, v in aux_out.items():
+            self.aux_dict[n]._write(v)
+        self.outputs = [NDArray(v, ctx=self._ctx) for v in out_vals]
+        # stash for backward
+        self._last_sig = sig
+        self._last_inputs = (arg_vals, aux_vals, rng)
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        """ref: executor.h Backward — vjp into bound grad arrays with
+        grad_req write/add semantics."""
+        if self._last_sig is None:
+            raise MXNetError("backward called before forward")
+        entry = self._fwd_cache[self._last_sig]
+        arg_vals, aux_vals, rng = self._last_inputs
+        if "vjp" not in entry:
+            def vjp_apply(av, xv, rng_, cts):
+                _, vjp_fn = jax.vjp(
+                    lambda a: entry["raw"](a, xv, rng_)[0], av)
+                return vjp_fn(cts)[0]
+            entry["vjp"] = jax.jit(vjp_apply)
+        if out_grads is None:
+            cts = tuple(jnp.ones_like(o._read()) for o in self.outputs)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cts = tuple(g._read() if isinstance(g, NDArray)
+                        else jnp.asarray(g) for g in out_grads)
+        grads = entry["vjp"](arg_vals, aux_vals, rng, cts)
+        for name in self._arg_names:
+            req = self.grad_req.get(name, "null")
+            tgt = self.grad_dict.get(name)
+            if req == "null" or tgt is None:
+                continue
+            g = grads[name]
+            if req == "add":
+                tgt._write(tgt._read() + g.astype(tgt._read().dtype))
+            else:
+                tgt._write(g.astype(tgt._read().dtype))
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Re-bind with new shapes (ref: executor.h Reshape). Cheap here:
+        a new signature just means a new jit cache entry."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = {}
+        for n, shape in zip(self._arg_names, arg_shapes):
+            cur = self.arg_dict[n]
+            if tuple(cur.shape) == tuple(shape):
+                new_args[n] = cur
+            else:
+                new_args[n] = nd.zeros(shape, ctx=self._ctx)
+        new_aux = {}
+        for n, shape in zip(self._aux_names, aux_shapes):
+            cur = self.aux_dict[n]
+            new_aux[n] = cur if tuple(cur.shape) == tuple(shape) \
+                else nd.zeros(shape, ctx=self._ctx)
+        grads = None
+        if self.grad_dict:
+            grads = {n: nd.zeros(shape, ctx=self._ctx)
+                     for n, shape in zip(self._arg_names, arg_shapes)}
+        return Executor(self._symbol, self._ctx, new_args, grads,
+                        self.grad_req, new_aux)
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """ref: executor.py copy_params_from."""
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                dst = self.arg_dict[name]
+                array.copyto(dst)
+            elif not allow_extra_params:
+                raise ValueError("Find name \"%s\" that is not in the arguments"
+                                 % name)
+        if aux_params is None:
+            return
+        for name, array in aux_params.items():
+            if name in self.aux_dict:
+                array.copyto(self.aux_dict[name])
+            elif not allow_extra_params:
+                raise ValueError("Find name %s that is not in the auxiliary "
+                                 "states" % name)
+
+    def set_monitor_callback(self, callback):
+        """ref: MXExecutorSetMonitorCallback (graph_executor.cc:121).
+        Installing a monitor disables jit for this executor so every node
+        output can be tapped eagerly (the reference pays a similar sync
+        cost when monitoring)."""
+        self._monitor_callback = callback
+        self._fwd_cache = {}
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def debug_str(self):
+        lines = ["Symbol outputs: %s" % ", ".join(self._symbol.list_outputs())]
+        for node in self._symbol._topo():
+            kind = "var" if node.is_variable() else node._op.name
+            lines.append("%s %s <- %s" % (kind, node._name,
+                                          [i._base()._name
+                                           for i in node._inputs]))
+        return "\n".join(lines)

@@ -1,0 +1,73 @@
+"""Auto-generation of the ``sym.<op>`` function surface.
+
+Parity with python/mxnet/symbol/register.py — one function per registered
+operator, splitting Symbol arguments from static attributes and creating a
+graph node. Same registry as the ndarray surface (one registration, both
+modes).
+"""
+from __future__ import annotations
+
+import keyword
+
+from ..ops.registry import _REGISTRY, Operator
+from .symbol import Symbol, _make_node
+
+
+def make_sym_func(op_name: str, op: Operator):
+    def generic_op(*args, name=None, attr=None, **kwargs):
+        from ..name import NameManager
+        from .symbol import var
+        inputs = []
+        rest = list(args)
+        while rest and isinstance(rest[0], Symbol):
+            inputs.append(rest.pop(0))
+        if rest:
+            raise TypeError(
+                "%s: positional arguments after Symbols must be keyword "
+                "attributes, got %r" % (op_name, rest))
+        req = op.arg_names({k: v for k, v in kwargs.items()
+                            if not isinstance(v, Symbol)})
+        if req is not None:
+            # named-input binding + auto-created variables for the missing
+            # ones (parity: MXSymbolCreateAtomicSymbol auto-vars named
+            # <node>_<input>, e.g. conv0_weight)
+            provided = dict(zip(req, inputs))
+            for n in req:
+                v = kwargs.pop(n, None)
+                if isinstance(v, Symbol):
+                    provided[n] = v
+            final_name = NameManager.current().get(
+                name, op.name.lower().lstrip("_"))
+            inputs = []
+            for n in req:
+                if n in provided:
+                    inputs.append(provided[n])
+                else:
+                    v = var("%s_%s" % (final_name, n))
+                    if n in op.aux_input_names:
+                        v._attr["__aux__"] = True
+                    inputs.append(v)
+            name = final_name
+        else:
+            for k in list(kwargs):
+                if isinstance(kwargs[k], Symbol):
+                    inputs.append(kwargs.pop(k))
+        node = _make_node(op, inputs, kwargs, name=name)
+        if attr:
+            node._attr.update(attr)
+        return node
+
+    generic_op.__name__ = op_name
+    generic_op.__qualname__ = op_name
+    generic_op.__doc__ = (op.doc or "") + "\n\n(auto-generated symbol fn; " \
+        "parity: python/mxnet/symbol/register.py codegen)"
+    return generic_op
+
+
+def populate(namespace: dict):
+    for name, op in list(_REGISTRY.items()):
+        if keyword.iskeyword(name) or not name.replace("_", "a").isidentifier():
+            continue
+        if name in namespace:
+            continue
+        namespace[name] = make_sym_func(name, op)

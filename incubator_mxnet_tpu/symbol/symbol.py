@@ -1,0 +1,635 @@
+"""Symbol: declarative graph construction.
+
+TPU-native rebirth of python/mxnet/symbol/symbol.py (2,848 LoC) + the NNVM
+graph (src/nnvm/):
+
+* A Symbol is a node in a static dataflow graph over the SAME operator
+  registry the eager NDArray path uses (one registry, two modes — MXNet's
+  defining design, SURVEY headline idea #2).
+* ``bind``/``simple_bind`` return an Executor whose forward compiles the
+  whole graph through jax.jit — the reference's GraphExecutor passes
+  (PlanMemory, inplace, op fusion, engine bulking; graph_executor.cc:512)
+  are all owned by XLA here.
+* ``tojson``/``load`` keep an MXNet-style JSON serialization (nodes with
+  op/name/attrs/inputs) so checkpoint workflows survive
+  (ref: src/nnvm/legacy_json_util.cc versioned JSON).
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ops.registry import get_op, Operator, _REGISTRY
+from ..name import NameManager
+from .. import attribute
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json"]
+
+
+class Symbol(object):
+    """A node (or node-output) of the symbolic graph."""
+
+    def __init__(self, op=None, inputs=None, params=None, name=None,
+                 num_outputs=1, out_index=None, attrs=None):
+        self._op = op                      # Operator or None (variable/group)
+        self._inputs = inputs or []        # list[Symbol]
+        self._params = params or {}        # static attrs
+        self._name = name
+        self._num_outputs = num_outputs
+        self._out_index = out_index        # not None → single output view
+        self._attr = dict(attrs or {})
+        self._group = None                 # list[Symbol] if this is a Group
+        self._view_of = None               # base node if this is an output view
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def name(self):
+        return self._name
+
+    def attr(self, key):
+        """ref: symbol.py attr."""
+        return self._attr.get(key)
+
+    def _set_attr(self, **kwargs):
+        self._attr.update(kwargs)
+
+    def list_attr(self):
+        return dict(self._attr)
+
+    def attr_dict(self):
+        """name → attrs for the whole graph (ref: symbol.py attr_dict)."""
+        ret = {}
+        for node in self._topo():
+            if node._attr:
+                ret[node._name] = dict(node._attr)
+        return ret
+
+    def __repr__(self):
+        if self._group is not None:
+            return "<Symbol group [%s]>" % ", ".join(s.name or "?" for s in self._group)
+        return "<Symbol %s>" % self._name
+
+    # -- graph walking -----------------------------------------------------
+    def _roots(self):
+        if self._group is not None:
+            return list(self._group)
+        return [self]
+
+    def _topo(self):
+        """Topological order of graph nodes (inputs before consumers)."""
+        seen = {}
+        order = []
+
+        def visit(node):
+            base = node._base()
+            if id(base) in seen:
+                return
+            seen[id(base)] = True
+            for i in base._inputs:
+                visit(i._base())
+            order.append(base)
+        for r in self._roots():
+            visit(r)
+        return order
+
+    def _base(self):
+        """Strip output-view indirection."""
+        return self._view_of if self._view_of is not None else self
+
+    # -- composition -------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        """Compose: replace variable inputs (ref: symbol.py __call__)."""
+        s = self._deepcopy()
+        s._compose(*args, **kwargs)
+        return s
+
+    def _deepcopy(self, memo=None):
+        if memo is None:
+            memo = {}
+        if id(self) in memo:
+            return memo[id(self)]
+        if self._view_of is not None:
+            base = self._view_of._deepcopy(memo)
+            cp = base[self._out_index]
+            memo[id(self)] = cp
+            return cp
+        cp = Symbol(self._op, [i._deepcopy(memo) for i in self._inputs],
+                    dict(self._params), self._name, self._num_outputs,
+                    self._out_index, dict(self._attr))
+        if self._group is not None:
+            cp._group = [g._deepcopy(memo) for g in self._group]
+        memo[id(self)] = cp
+        return cp
+
+    def _compose(self, *args, **kwargs):
+        name = kwargs.pop("name", None)
+        if name:
+            self._name = name
+        if args and kwargs:
+            raise TypeError("compose only accept input Symbols "
+                            "either as positional or keyword arguments, not both")
+        arg_names = [i.name for i in self._free_variables()]
+        if args:
+            kwargs = dict(zip(arg_names, args))
+        for node in self._topo():
+            new_inputs = []
+            for i in node._inputs:
+                if i._base().is_variable() and i._base().name in kwargs:
+                    new_inputs.append(kwargs[i._base().name])
+                else:
+                    new_inputs.append(i)
+            node._inputs = new_inputs
+
+    def is_variable(self):
+        return self._op is None and self._group is None
+
+    def _free_variables(self):
+        return [n for n in self._topo() if n.is_variable()]
+
+    # -- listing -----------------------------------------------------------
+    def list_arguments(self):
+        """Variable names in topo order (ref: symbol.py list_arguments)."""
+        return [n.name for n in self._free_variables()
+                if not n._attr.get("__aux__")]
+
+    def list_auxiliary_states(self):
+        """ref: symbol.py list_auxiliary_states — aux-flagged variables
+        (BatchNorm moving stats)."""
+        return [n.name for n in self._free_variables()
+                if n._attr.get("__aux__")]
+
+    def list_outputs(self):
+        outs = []
+        for r in self._roots():
+            base_name = r._name or "out"
+            if r.is_variable():
+                outs.append(base_name)
+            elif r._num_outputs == 1 or r._out_index is not None:
+                outs.append(base_name + "_output")
+            else:
+                outs.extend("%s_output%d" % (base_name, i)
+                            for i in range(r._num_outputs))
+        return outs
+
+    def get_internals(self):
+        """All intermediate outputs as a group (ref: symbol.py get_internals)."""
+        nodes = [n for n in self._topo()]
+        return Group([n if n._num_outputs == 1 else n[0] for n in nodes])
+
+    def __getitem__(self, index):
+        if self._group is not None:
+            if isinstance(index, str):
+                names = self.list_outputs()
+                index = names.index(index)
+            return self._group[index]
+        if isinstance(index, str):
+            names = self.list_outputs()
+            index = names.index(index)
+        if index >= self._num_outputs:
+            raise IndexError("Index: %d is greater than the number of outputs: %d."
+                             % (index, self._num_outputs))
+        if self._num_outputs == 1:
+            return self
+        view = Symbol(self._op, self._inputs, self._params, self._name,
+                      self._num_outputs, out_index=index, attrs=self._attr)
+        view._view_of = self
+        return view
+
+    @property
+    def num_outputs(self):
+        if self._group is not None:
+            return len(self._group)
+        return 1 if self._out_index is not None else self._num_outputs
+
+    # -- arithmetic composition -------------------------------------------
+    def _binop(self, other, op_name, scalar_op, reverse=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _make_node(get_op(op_name), [a, b], {})
+        if isinstance(other, (int, float, bool, np.generic)):
+            return _make_node(get_op(scalar_op), [self],
+                              {"scalar": float(other)})
+        raise TypeError("type %s not supported" % str(type(other)))
+
+    def __add__(self, o):
+        return self._binop(o, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        if isinstance(o, (int, float)):
+            return _make_node(get_op("_rminus_scalar"), [self],
+                              {"scalar": float(o)})
+        return self._binop(o, "elemwise_sub", "_minus_scalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "elemwise_div", "_div_scalar")
+
+    __div__ = __truediv__
+
+    def __rtruediv__(self, o):
+        if isinstance(o, (int, float)):
+            return _make_node(get_op("_rdiv_scalar"), [self],
+                              {"scalar": float(o)})
+        return self._binop(o, "elemwise_div", "_div_scalar", reverse=True)
+
+    def __pow__(self, o):
+        return self._binop(o, "broadcast_power", "_power_scalar")
+
+    def __neg__(self):
+        return _make_node(get_op("negative"), [self], {})
+
+    def __copy__(self):
+        return self._deepcopy()
+
+    def __deepcopy__(self, memo):
+        return self._deepcopy()
+
+    def __eq__(self, o):
+        if isinstance(o, (Symbol, int, float)):
+            try:
+                return self._binop(o, "broadcast_equal", "_equal_scalar")
+            except Exception:
+                return NotImplemented
+        return NotImplemented
+
+    def __ne__(self, o):
+        if isinstance(o, (Symbol, int, float)):
+            try:
+                return self._binop(o, "broadcast_not_equal",
+                                   "_not_equal_scalar")
+            except Exception:
+                return NotImplemented
+        return NotImplemented
+
+    def __bool__(self):
+        raise NotImplementedError(
+            "The truth value of a Symbol is ambiguous (it is a graph node, "
+            "not a value); use identity checks (`is`) for membership.")
+
+    __hash__ = object.__hash__
+
+    # -- inference ---------------------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        """Infer shapes (ref: symbol.py infer_shape). Returns
+        (arg_shapes, out_shapes, aux_shapes)."""
+        try:
+            res = self._infer_shape_impl(False, *args, **kwargs)
+            if res[1] is None:
+                arg_shapes, _, _ = self._infer_shape_impl(True, *args, **kwargs)
+                arg_names = self.list_arguments()
+                unknowns = []
+                for name, shape in zip(arg_names, arg_shapes or
+                                       [None] * len(arg_names)):
+                    if not shape or 0 in shape:
+                        unknowns.append("%s: %s" % (name, str(shape)))
+                import warnings
+                warnings.warn("Cannot decide shape for the following arguments "
+                              "(0s in shape means unknown dimensions). "
+                              "Consider providing them as input:\n\t" +
+                              "\n\t".join(unknowns), stacklevel=2)
+            return res
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        """ref: symbol.py infer_shape_partial."""
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        if args and kwargs:
+            raise ValueError("Can only specify known argument shapes either by "
+                            "positional or kwargs way.")
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        known = {}
+        if args:
+            for name, shape in zip(arg_names, args):
+                if shape is not None:
+                    known[name] = shape
+        else:
+            known.update({k: v for k, v in kwargs.items() if v is not None})
+        shapes, ok = self._propagate_shapes(known, partial)
+        if not ok and not partial:
+            return (None, None, None)
+        arg_shapes = [shapes.get(n) for n in arg_names]
+        aux_shapes = [shapes.get(n) for n in aux_names]
+        out_shapes = []
+        for r in self._roots():
+            b = r._base()
+            if b.is_variable():
+                out_shapes.append(shapes.get(b.name))
+            else:
+                out_shapes.append(shapes.get(_out_key(b, r._out_index or 0)))
+        return (arg_shapes, out_shapes, aux_shapes)
+
+    def _propagate_shapes(self, known, partial):
+        """Forward shape propagation via op.infer (jax.eval_shape)."""
+        shapes = dict(known)
+        ok = True
+        topo = self._topo()
+        for node in topo:
+            if node.is_variable():
+                if shapes.get(node.name) is None:
+                    declared = node._attr.get("__shape__")
+                    if declared and 0 not in declared:
+                        shapes[node.name] = tuple(declared)
+                continue
+            in_keys = []
+            for i in node._inputs:
+                b = i._base()
+                if b.is_variable():
+                    in_keys.append(b.name)
+                else:
+                    in_keys.append(_out_key(b, i._out_index or 0))
+            if any(k not in shapes for k in in_keys):
+                # bidirectional half of FInferShape: fill parameter-variable
+                # shapes from the (known) data shape via the op's
+                # finfer_params (ref: convolution.cc FInferShape fills
+                # weight/bias from dshape)
+                filled = False
+                if node._op.finfer_params is not None and in_keys and \
+                        in_keys[0] in shapes:
+                    pshapes = node._op.finfer_params(tuple(shapes[in_keys[0]]),
+                                                     node._params)
+                    req = node._op.arg_names(node._params) or []
+                    for iname, key, inp in zip(req, in_keys, node._inputs):
+                        if key not in shapes and inp._base().is_variable() \
+                                and iname in pshapes:
+                            shapes[key] = tuple(pshapes[iname])
+                            filled = True
+                if any(k not in shapes for k in in_keys):
+                    ok = False
+                    continue
+            in_shapes = [(tuple(shapes[k]), np.float32) for k in in_keys]
+            try:
+                outs = node._op.infer(in_shapes, node._params)
+            except Exception as e:
+                if partial:
+                    ok = False
+                    continue
+                raise MXNetError("Error in operator %s: %s" % (node._name, e))
+            for i, (shape, dtype) in enumerate(outs):
+                shapes[_out_key(node, i)] = shape
+        # complete iff every variable got a shape (consumers may have
+        # back-filled them after their visit) and every root resolved
+        for node in topo:
+            if node.is_variable() and shapes.get(node.name) is None:
+                ok = False
+        for r in self._roots():
+            b = r._base()
+            key = b.name if b.is_variable() else _out_key(b, r._out_index or 0)
+            if shapes.get(key) is None:
+                ok = False
+        return shapes, ok
+
+    def infer_type(self, *args, **kwargs):
+        """ref: symbol.py infer_type — single-dtype propagation."""
+        arg_names = self.list_arguments()
+        dtype = np.float32
+        if args:
+            for a in args:
+                if a is not None:
+                    dtype = np.dtype(a)
+                    break
+        elif kwargs:
+            dtype = np.dtype(list(kwargs.values())[0])
+        arg_types = [dtype for _ in arg_names]
+        out_types = [dtype for _ in self._roots()]
+        aux_types = [dtype for _ in self.list_auxiliary_states()]
+        return (arg_types, out_types, aux_types)
+
+    # -- serialization -----------------------------------------------------
+    def tojson(self):
+        """MXNet-style JSON graph (ref: symbol.py tojson / save)."""
+        nodes = []
+        index = {}
+        topo = self._topo()
+        for node in topo:
+            in_entries = []
+            for i in node._inputs:
+                in_entries.append([index[id(i._base())], i._out_index or 0, 0])
+            entry = {
+                "op": "null" if node.is_variable() else node._op.name,
+                "name": node._name,
+                "inputs": in_entries,
+            }
+            attrs = dict(node._params)
+            if node._attr:
+                attrs["__sym_attr__"] = dict(node._attr)
+            if attrs:
+                entry["attrs"] = {k: json.dumps(v) if not isinstance(v, str)
+                                  else v for k, v in attrs.items()}
+            index[id(node)] = len(nodes)
+            nodes.append(entry)
+        heads = [[index[id(r._base())], r._out_index or 0, 0]
+                 for r in self._roots()]
+        arg_nodes = [index[id(n)] for n in topo if n.is_variable()]
+        return json.dumps({"nodes": nodes, "arg_nodes": arg_nodes,
+                           "node_row_ptr": list(range(len(nodes) + 1)),
+                           "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 10200]}},
+                          indent=2)
+
+    def save(self, fname):
+        """ref: symbol.py save."""
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- evaluation --------------------------------------------------------
+    def eval_dict(self, value_map):
+        """Evaluate with a name→NDArray map; returns output NDArray(s)."""
+        from ..ndarray import NDArray
+        from ..ndarray.ndarray import invoke
+        cache = {}
+        for node in self._topo():
+            if node.is_variable():
+                if node.name not in value_map:
+                    raise MXNetError("eval missing input %s" % node.name)
+                cache[id(node)] = [value_map[node.name]]
+                continue
+            ins = []
+            for i in node._inputs:
+                vals = cache[id(i._base())]
+                ins.append(vals[min(i._out_index or 0, len(vals) - 1)])
+            out = invoke(node._op, ins, dict(node._params))
+            cache[id(node)] = out if isinstance(out, list) else [out]
+        results = []
+        for r in self._roots():
+            vals = cache[id(r._base())]
+            results.append(vals[min(r._out_index or 0, len(vals) - 1)])
+        return results[0] if len(results) == 1 else results
+
+    def eval(self, ctx=None, **kwargs):
+        """ref: symbol.py eval."""
+        out = self.eval_dict(kwargs)
+        return out if isinstance(out, list) else [out]
+
+    # -- binding -----------------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        """Allocate arrays and bind (ref: symbol.py simple_bind →
+        GraphExecutor::Init, graph_executor.cc:512)."""
+        from .executor import Executor
+        from .. import ndarray as nd
+        arg_shapes, out_shapes, aux_shapes = self.infer_shape(**kwargs)
+        if arg_shapes is None:
+            raise ValueError("cannot infer shapes for all arguments")
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        shared = shared_buffer if shared_buffer is not None else {}
+        args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            if shared_exec is not None and name in shared_exec.arg_dict and \
+                    tuple(shared_exec.arg_dict[name].shape) == tuple(shape):
+                args[name] = shared_exec.arg_dict[name]
+            elif name in shared and tuple(shared[name].shape) == tuple(shape):
+                args[name] = shared[name]
+            else:
+                args[name] = nd.zeros(shape, ctx=ctx)
+                shared[name] = args[name]
+        aux = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            if shared_exec is not None and name in shared_exec.aux_dict and \
+                    tuple(shared_exec.aux_dict[name].shape) == tuple(shape):
+                aux[name] = shared_exec.aux_dict[name]
+            else:
+                aux[name] = nd.zeros(shape, ctx=ctx)
+        if isinstance(grad_req, str):
+            req_of = {n: grad_req for n in arg_names}
+        else:
+            req_of = {n: grad_req.get(n, "null") for n in arg_names}
+        grad_arrays = {name: nd.zeros(shape, ctx=ctx)
+                       for name, shape in zip(arg_names, arg_shapes)
+                       if req_of[name] != "null"} or None
+        return Executor(self, ctx, args, grad_arrays, grad_req, aux)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        """ref: symbol.py bind → Executor."""
+        from .executor import Executor
+        arg_names = self.list_arguments()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(arg_names, args_grad))
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(self.list_auxiliary_states(), aux_states))
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states or {})
+
+    # convenience mirrors of the reference's symbol method surface
+    def get_name(self):
+        return self._name
+
+
+def _out_key(node, idx=0):
+    return "#out#%d#%d" % (id(node), idx)
+
+
+def _make_node(op, inputs, params, name=None):
+    hint = op.name.lower().lstrip("_")
+    final_name = NameManager.current().get(name, hint)
+    attrs = attribute.current().get(None)
+    n_out = op.num_outputs
+    s = Symbol(op, list(inputs), params, final_name, n_out, attrs=attrs)
+    if n_out == 1:
+        return s
+    return s
+
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs):
+    """Create a variable symbol (ref: symbol.py var / Variable)."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    attrs = attribute.current().get(attr)
+    s = Symbol(None, name=name, attrs=attrs)
+    if shape is not None:
+        s._attr["__shape__"] = tuple(shape)
+    if lr_mult is not None:
+        s._attr["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        s._attr["__wd_mult__"] = wd_mult
+    if dtype is not None:
+        s._attr["__dtype__"] = np.dtype(dtype).name
+    if init is not None:
+        if not isinstance(init, str):
+            init = init.dumps()
+        s._attr["__init__"] = init
+    for k, v in kwargs.items():
+        if k.startswith("__") and k.endswith("__"):
+            s._attr[k] = v
+    return s
+
+
+Variable = var
+
+
+def Group(symbols):
+    """Group symbols into one multi-output symbol (ref: symbol.py Group)."""
+    if not symbols or any(not isinstance(sym, Symbol) for sym in symbols):
+        raise TypeError("Expected a list of symbols as input")
+    s = Symbol(name="group")
+    s._group = [sym for sym in symbols]
+    return s
+
+
+def load_json(json_str):
+    """Rebuild a Symbol from JSON (ref: symbol.py load_json; versioned
+    upgrade path of legacy_json_util.cc collapses to one format here)."""
+    graph = json.loads(json_str)
+    nodes = []
+    for entry in graph["nodes"]:
+        op_name = entry["op"]
+        attrs = dict(entry.get("attrs", {}))
+        sym_attr = attrs.pop("__sym_attr__", None)
+        if isinstance(sym_attr, str):
+            sym_attr = json.loads(sym_attr)
+        parsed = {}
+        for k, v in attrs.items():
+            if isinstance(v, str):
+                try:
+                    parsed[k] = json.loads(v)
+                except (ValueError, TypeError):
+                    parsed[k] = v
+            else:
+                parsed[k] = v
+        if op_name == "null":
+            s = Symbol(None, name=entry["name"], attrs=sym_attr)
+            if parsed:
+                s._attr.update({k: tuple(v) if isinstance(v, list) else v
+                                for k, v in parsed.items()})
+        else:
+            ins = []
+            for (nid, out_i, _) in entry["inputs"]:
+                src = nodes[nid]
+                ins.append(src if out_i == 0 and src.num_outputs == 1
+                           else src[out_i])
+            op = get_op(op_name)
+            s = Symbol(op, ins, parsed, entry["name"], op.num_outputs,
+                       attrs=sym_attr)
+        nodes.append(s)
+    heads = [nodes[nid] if out_i == 0 and nodes[nid].num_outputs == 1
+             else nodes[nid][out_i]
+             for (nid, out_i, _) in graph["heads"]]
+    if len(heads) == 1:
+        return heads[0]
+    return Group(heads)
+
+
+def load(fname):
+    """ref: symbol.py load."""
+    with open(fname) as f:
+        return load_json(f.read())

@@ -1,0 +1,194 @@
+"""Module API tests (parity model: tests/python/unittest/test_module.py)."""
+import logging
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+
+def _mlp_sym(num_hidden=32, classes=3):
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=num_hidden, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=classes, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def _toy_data(n=120, d=10, k=3, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, d).astype(np.float32)
+    w = rs.randn(d, k).astype(np.float32)
+    y = (X @ w).argmax(axis=1).astype(np.float32)
+    return X, y
+
+
+def test_module_fit_and_score():
+    X, y = _toy_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=20, shuffle=True)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=12, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier())
+    acc = mod.score(mx.io.NDArrayIter(X, y, batch_size=20), "acc")[0][1]
+    assert acc > 0.9
+
+
+def test_module_forward_backward_update_loop():
+    X, y = _toy_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    metric = mx.metric.create("acc")
+    for _ in range(10):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            mod.update_metric(metric, batch.label)
+    assert metric.get()[1] > 0.9
+
+
+def test_module_multi_device():
+    X, y = _toy_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.mod.Module(_mlp_sym(), context=[mx.cpu(0), mx.cpu(1)])
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(kvstore="device", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    metric = mx.metric.create("acc")
+    for _ in range(10):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            mod.update_metric(metric, batch.label)
+    assert metric.get()[1] > 0.9
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    X, y = _toy_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    prefix = str(tmp_path / "ck")
+    mod.save_checkpoint(prefix, 3)
+    mod2 = mx.mod.Module.load(prefix, 3, context=mx.cpu())
+    mod2.bind([("data", (20, 10))], [("softmax_label", (20,))],
+              for_training=False)
+    p1 = mod.predict(mx.io.NDArrayIter(X, batch_size=20))
+    p2 = mod2.predict(mx.io.NDArrayIter(X, batch_size=20))
+    np.testing.assert_allclose(p1.asnumpy(), p2.asnumpy(), rtol=1e-5)
+
+
+def test_module_predict_shapes():
+    X, y = _toy_data()
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=30)
+    mod.bind(it.provide_data, it.provide_label, for_training=False)
+    mod.init_params()
+    out = mod.predict(mx.io.NDArrayIter(X, batch_size=30))
+    assert out.shape == (120, 3)
+
+
+def test_module_input_grads():
+    X, y = _toy_data()
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind([("data", (20, 10))], [("softmax_label", (20,))],
+             for_training=True, inputs_need_grad=True)
+    mod.init_params()
+    batch = mx.io.DataBatch([mx.nd.array(X[:20])], [mx.nd.array(y[:20])])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    grads = mod.get_input_grads()
+    assert grads[0].shape == (20, 10)
+    assert np.abs(grads[0].asnumpy()).sum() > 0
+
+
+def test_module_reshape():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind([("data", (20, 10))], [("softmax_label", (20,))])
+    mod.init_params()
+    mod.reshape([("data", (10, 10))], [("softmax_label", (10,))])
+    batch = mx.io.DataBatch([mx.nd.zeros((10, 10))], [mx.nd.zeros((10,))])
+    mod.forward(batch, is_train=False)
+    assert mod.get_outputs()[0].shape == (10, 3)
+
+
+def test_module_optimizer_states_io(tmp_path):
+    X, y = _toy_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    mod.update()
+    fname = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(fname)
+    mod.load_optimizer_states(fname)
+
+
+def test_bucketing_module():
+    """Variable-length MLP buckets sharing params (parity:
+    test_module.py test_bucket_module semantics)."""
+    def sym_gen(bucket_key):
+        data = mx.sym.var("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=8, name="fc_shared")
+        out = mx.sym.SoftmaxOutput(fc, mx.sym.var("softmax_label"),
+                                   name="softmax")
+        return out, ["data"], ["softmax_label"]
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    from incubator_mxnet_tpu.io import DataBatch, DataDesc
+    mod.bind([DataDesc("data", (4, 10))], [DataDesc("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    for key in (10, 10, 10):
+        batch = DataBatch([mx.nd.zeros((4, key))], [mx.nd.zeros((4,))],
+                          bucket_key=key,
+                          provide_data=[DataDesc("data", (4, key))],
+                          provide_label=[DataDesc("softmax_label", (4,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    assert mod.get_outputs()[0].shape == (4, 8)
+
+
+def test_feedforward_shim():
+    X, y = _toy_data()
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ff = mx.model.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=15,
+                                  learning_rate=0.5, numpy_batch_size=20)
+        ff.fit(X, y)
+        pred = ff.predict(X)
+    assert pred.shape == (120, 3)
+    assert (pred.argmax(axis=1) == y).mean() > 0.8
+
+
+def test_save_load_checkpoint_functions(tmp_path):
+    sym = _mlp_sym()
+    arg = {"fc1_weight": mx.nd.ones((32, 10))}
+    aux = {}
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 7, sym, arg, aux)
+    sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 7)
+    assert sym2.list_outputs() == sym.list_outputs()
+    np.testing.assert_allclose(arg2["fc1_weight"].asnumpy(), np.ones((32, 10)))

@@ -1,0 +1,178 @@
+"""Symbol tests (parity model: tests/python/unittest/test_symbol.py +
+test_infer_shape.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, mx.sym.var("softmax_label"), name="sm")
+
+
+def test_list_arguments_auto_vars():
+    out = _mlp()
+    assert out.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                    "fc2_weight", "fc2_bias", "softmax_label"]
+    assert out.list_outputs() == ["sm_output"]
+
+
+def test_infer_shape_fills_params():
+    out = _mlp()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(
+        data=(8, 10), softmax_label=(8,))
+    args = dict(zip(out.list_arguments(), arg_shapes))
+    assert args["fc1_weight"] == (16, 10)
+    assert args["fc2_weight"] == (4, 16)
+    assert out_shapes == [(8, 4)]
+
+
+def test_infer_shape_conv():
+    data = mx.sym.var("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                              name="c0")
+    arg_shapes, out_shapes, _ = conv.infer_shape(data=(2, 3, 16, 16))
+    args = dict(zip(conv.list_arguments(), arg_shapes))
+    assert args["c0_weight"] == (8, 3, 3, 3)
+    assert args["c0_bias"] == (8,)
+    assert out_shapes == [(2, 8, 16, 16)]
+
+
+def test_batchnorm_aux_states():
+    bn = mx.sym.BatchNorm(mx.sym.var("x"), name="bn")
+    assert bn.list_arguments() == ["x", "bn_gamma", "bn_beta"]
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    arg_shapes, _, aux_shapes = bn.infer_shape(x=(2, 5, 4, 4))
+    assert aux_shapes == [(5,), (5,)]
+
+
+def test_compose():
+    net1 = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=10, name="fc1")
+    net2 = mx.sym.FullyConnected(mx.sym.var("other"), num_hidden=4, name="fc2")
+    composed = net2(other=net1, name="composed")
+    args = composed.list_arguments()
+    assert "data" in args and "fc1_weight" in args and "fc2_weight" in args
+    assert "other" not in args
+
+
+def test_symbol_arithmetic_eval():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = (a + b * 2.0) / 2.0
+    r = c.eval_dict({"a": mx.nd.ones((2, 2)), "b": mx.nd.ones((2, 2))})
+    np.testing.assert_allclose(r.asnumpy(), np.full((2, 2), 1.5))
+
+
+def test_json_roundtrip():
+    out = _mlp()
+    js = out.tojson()
+    out2 = mx.sym.load_json(js)
+    assert out2.list_arguments() == out.list_arguments()
+    assert out2.list_outputs() == out.list_outputs()
+    _, shapes1, _ = out.infer_shape(data=(4, 6), softmax_label=(4,))
+    _, shapes2, _ = out2.infer_shape(data=(4, 6), softmax_label=(4,))
+    assert shapes1 == shapes2
+
+
+def test_save_load_file(tmp_path):
+    out = _mlp()
+    fname = str(tmp_path / "sym.json")
+    out.save(fname)
+    out2 = mx.sym.load(fname)
+    assert out2.list_arguments() == out.list_arguments()
+
+
+def test_group_and_getitem():
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    fc2 = mx.sym.FullyConnected(data, num_hidden=4, name="fc2")
+    grp = mx.sym.Group([fc1, fc2])
+    assert grp.list_outputs() == ["fc1_output", "fc2_output"]
+    assert grp[0].name == "fc1"
+    _, out_shapes, _ = grp.infer_shape(data=(2, 8))
+    assert out_shapes == [(2, 16), (2, 4)]
+
+
+def test_get_internals():
+    out = _mlp()
+    internals = out.get_internals()
+    names = internals.list_outputs()
+    assert any("fc1" in n for n in names)
+
+
+def test_executor_forward_backward():
+    out = _mlp()
+    exe = out.simple_bind(ctx=mx.cpu(), data=(8, 10), softmax_label=(8,))
+    rs = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = rs.randn(*arr.shape).astype(np.float32) * 0.1
+    outs = exe.forward(is_train=True,
+                       data=rs.randn(8, 10).astype(np.float32),
+                       softmax_label=rs.randint(0, 4, (8,)).astype(np.float32))
+    assert outs[0].shape == (8, 4)
+    np.testing.assert_allclose(outs[0].asnumpy().sum(axis=1),
+                               np.ones(8), rtol=1e-5)  # softmax rows
+    exe.backward()
+    assert np.abs(exe.grad_dict["fc1_weight"].asnumpy()).sum() > 0
+
+
+def test_executor_grad_add_req():
+    x = mx.sym.var("x")
+    y = mx.sym.sum(x * x)
+    exe = y.bind(mx.cpu(), {"x": mx.nd.array(np.ones(3, np.float32))},
+                 args_grad={"x": mx.nd.zeros(3)}, grad_req="add")
+    exe.forward(is_train=True)
+    exe.backward()
+    exe.forward(is_train=True)
+    exe.backward()
+    np.testing.assert_allclose(exe.grad_dict["x"].asnumpy(), np.full(3, 4.0))
+
+
+def test_executor_reshape():
+    out = _mlp()
+    exe = out.simple_bind(ctx=mx.cpu(), data=(8, 10), softmax_label=(8,))
+    exe2 = exe.reshape(data=(4, 10), softmax_label=(4,))
+    assert exe2.arg_dict["data"].shape == (4, 10)
+    # params shared
+    assert exe2.arg_dict["fc1_weight"] is exe.arg_dict["fc1_weight"]
+
+
+def test_monitor_callback():
+    out = _mlp()
+    exe = out.simple_bind(ctx=mx.cpu(), data=(2, 10), softmax_label=(2,))
+    taps = []
+    exe.set_monitor_callback(lambda name, arr: taps.append(name))
+    exe.forward(is_train=False, data=np.zeros((2, 10), np.float32),
+                softmax_label=np.zeros((2,), np.float32))
+    assert any("fc1" in t for t in taps)
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = mx.sym.var("a")
+        b = mx.sym.FullyConnected(a, num_hidden=3)
+    assert a.attr("ctx_group") == "dev1"
+    assert b.attr("ctx_group") == "dev1"
+
+
+def test_var_shape_attr():
+    v = mx.sym.var("w", shape=(3, 4))
+    fc = mx.sym.FullyConnected(mx.sym.var("data"), weight=v, num_hidden=3,
+                               no_bias=True)
+    arg_shapes, out_shapes, _ = fc.infer_shape(data=(2, 4))
+    assert out_shapes == [(2, 3)]
+
+
+def test_autograd_get_symbol():
+    from incubator_mxnet_tpu import autograd
+    x = mx.nd.array(np.ones((2, 2), np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * 2).sum()
+    s = autograd.get_symbol(y)
+    assert isinstance(s, mx.sym.Symbol)
