@@ -149,8 +149,12 @@ class KVStore(object):
     # -- reductions --------------------------------------------------------
     @staticmethod
     def _reduce(vlist):
+        from .ndarray.sparse import BaseSparseNDArray, add_n
         if len(vlist) == 1:
             return vlist[0]
+        if any(isinstance(v, BaseSparseNDArray) for v in vlist):
+            # sparse-aware tree sum (ref: comm.h CommCPU ReduceRowSparse)
+            return add_n(*vlist)
         acc = vlist[0]._read()
         for v in vlist[1:]:
             acc = acc + v._read()

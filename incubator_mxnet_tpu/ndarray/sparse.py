@@ -208,3 +208,102 @@ def retain(arr, row_ids):
         NDArray(arr.data._read()[jnp.asarray(keep, jnp.int32)], ctx=arr._ctx),
         _dense_array(have[keep], ctx=arr._ctx, dtype=np.int64),
         arr.shape, ctx=arr._ctx)
+
+
+# ---------------------------------------------------------------------------
+# Sparse compute (ref: src/operator/tensor/dot-inl.h sparse kernels,
+# elemwise ops with FComputeEx) — gather/segment-sum formulations that XLA
+# lowers to TPU-friendly dense gathers + sorted scatters.
+# ---------------------------------------------------------------------------
+
+from functools import partial as _partial
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _csr_matmul(data, col, row, rhs, m):
+    """CSR(m×k) @ dense(k×n) with differentiable data/rhs."""
+    contrib = data[:, None] * rhs[col]                  # (nnz, n)
+    return jax.ops.segment_sum(contrib, row, num_segments=m)
+
+
+def _csr_matmul_fwd(data, col, row, rhs, m):
+    return _csr_matmul(data, col, row, rhs, m), (data, col, row, rhs)
+
+
+def _csr_matmul_bwd(m, res, g):
+    data, col, row, rhs = res
+    d_data = (g[row] * rhs[col]).sum(axis=1)
+    d_rhs = jax.ops.segment_sum(data[:, None] * g[row], col,
+                                num_segments=rhs.shape[0])
+    return (d_data, None, None, d_rhs)
+
+
+_csr_matmul.defvjp(_csr_matmul_fwd, _csr_matmul_bwd)
+
+
+def _csr_row_ids(csr):
+    ptr = csr.indptr._read().astype(jnp.int32)
+    nnz = csr.data._read().shape[0]
+    return jnp.searchsorted(ptr, jnp.arange(nnz), side="right") - 1
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (ref: dot-inl.h — csr×dense and csrᵀ×dense kernels;
+    python surface mx.nd.sparse.dot)."""
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs, BaseSparseNDArray):
+        row = _csr_row_ids(lhs)
+        col = lhs.indices._read().astype(jnp.int32)
+        data = lhs.data._read()
+        r = rhs._read()
+        if transpose_b:
+            r = r.T
+        if transpose_a:
+            # csrᵀ @ dense: scatter rows of dense by col
+            out = jax.ops.segment_sum(data[:, None] * r[row], col,
+                                      num_segments=lhs.shape[1])
+            return NDArray(out, ctx=lhs._ctx)
+        out = _csr_matmul(data, col, row, r, lhs.shape[0])
+        return NDArray(out, ctx=lhs._ctx)
+    if isinstance(lhs, RowSparseNDArray) and not isinstance(rhs, BaseSparseNDArray):
+        # rsp @ dense: dense rows gather-matmul, scatter into result
+        idx = lhs.indices._read().astype(jnp.int32)
+        out = jnp.zeros((lhs.shape[0], rhs.shape[1]), lhs.data._read().dtype)
+        out = out.at[idx].set(lhs.data._read() @ rhs._read())
+        return NDArray(out, ctx=lhs._ctx)
+    if isinstance(rhs, BaseSparseNDArray):
+        # dense @ csr: (csrᵀ @ denseᵀ)ᵀ
+        return NDArray(dot(rhs, NDArray(lhs._read().T, ctx=lhs._ctx),
+                           transpose_a=not transpose_b)._read().T,
+                       ctx=lhs._ctx)
+    from .ndarray import invoke
+    from ..ops.registry import get_op
+    return invoke(get_op("dot"), [lhs, rhs],
+                  {"transpose_a": transpose_a, "transpose_b": transpose_b})
+
+
+def elemwise_add(lhs, rhs):
+    """rsp+rsp → rsp (ref: elemwise_binary_op FComputeEx rsp,rsp)."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        idx = jnp.concatenate([lhs.indices._read(), rhs.indices._read()])
+        vals = jnp.concatenate([lhs.data._read(), rhs.data._read()])
+        uniq, inv = jnp.unique(idx, return_inverse=True,
+                               size=idx.shape[0], fill_value=lhs.shape[0])
+        summed = jax.ops.segment_sum(vals, inv.astype(jnp.int32),
+                                     num_segments=idx.shape[0])
+        keep = uniq < lhs.shape[0]
+        k = int(keep.sum())
+        return RowSparseNDArray(
+            NDArray(summed[:k], ctx=lhs._ctx),
+            NDArray(uniq[:k].astype(jnp.int64), ctx=lhs._ctx),
+            lhs.shape, ctx=lhs._ctx)
+    a = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    b = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return a + b
+
+
+def add_n(*arrays):
+    """Sum of sparse/dense arrays (ref: elemwise_sum FComputeEx)."""
+    acc = arrays[0]
+    for a in arrays[1:]:
+        acc = elemwise_add(acc, a)
+    return acc

@@ -195,11 +195,35 @@ class SGD(Optimizer):
         self._update_count(index)
         kw = _common_kwargs(self, index)
         kw["lazy_update"] = self.lazy_update
+        from .ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            # lazy update: touch only occupied rows (ref: optimizer_op.cc
+            # SGDUpdateRspRspImpl — the row-sparse kernel)
+            self._sparse_sgd(weight, grad, state, kw)
+            return
         if state is not None:
             kw["momentum"] = self.momentum
             invoke(get_op("sgd_mom_update"), [weight, grad, state], kw, out=weight)
         else:
             invoke(get_op("sgd_update"), [weight, grad], kw, out=weight)
+
+    def _sparse_sgd(self, weight, grad, state, kw):
+        import jax.numpy as jnp
+        idx = grad.indices._read().astype(jnp.int32)
+        g = grad.data._read() * kw["rescale_grad"]
+        clip = kw.get("clip_gradient", -1.0)
+        if clip and clip > 0:
+            g = jnp.clip(g, -clip, clip)
+        w = weight._read()
+        rows = w[idx]
+        g = g + kw["wd"] * rows
+        if state is not None:
+            m = state._read()
+            new_rows_m = self.momentum * m[idx] - kw["lr"] * g
+            state._write(m.at[idx].set(new_rows_m))
+            weight._write(w.at[idx].set(rows + new_rows_m))
+        else:
+            weight._write(w.at[idx].set(rows - kw["lr"] * g))
 
     def update_multi_precision(self, index, weight, grad, state):
         use_mp = self.multi_precision and weight.dtype in (np.dtype("float16"),

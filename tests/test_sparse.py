@@ -1,0 +1,142 @@
+"""Sparse NDArray tests (parity model: tests/python/unittest/
+test_sparse_ndarray.py + test_sparse_operator.py + the sparse end-to-end
+benchmark benchmark/python/sparse/sparse_end2end.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.ndarray import sparse as sp
+
+
+def test_rsp_roundtrip():
+    a = np.array([[0, 0], [1, 2], [0, 0], [3, 4]], np.float32)
+    rsp = sp.row_sparse_array(a)
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_allclose(rsp.asnumpy(), a)
+    np.testing.assert_allclose(rsp.indices.asnumpy(), [1, 3])
+    dense = rsp.tostype("default")
+    np.testing.assert_allclose(dense.asnumpy(), a)
+
+
+def test_csr_roundtrip():
+    a = np.array([[0, 2, 0], [1, 0, 3]], np.float32)
+    csr = sp.csr_matrix(a)
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.asnumpy(), a)
+    np.testing.assert_allclose(csr.indptr.asnumpy(), [0, 1, 3])
+    back = csr.tostype("default")
+    np.testing.assert_allclose(back.asnumpy(), a)
+
+
+def test_cast_storage_via_ndarray():
+    a = mx.nd.array(np.array([[1, 0], [0, 0]], np.float32))
+    rsp = a.tostype("row_sparse")
+    assert rsp.stype == "row_sparse"
+    assert rsp.indices.shape == (1,)
+
+
+def test_retain():
+    a = np.diag(np.arange(1.0, 5.0)).astype(np.float32)
+    rsp = sp.row_sparse_array(a)
+    kept = sp.retain(rsp, mx.nd.array(np.array([0, 2], np.float32)))
+    np.testing.assert_allclose(kept.indices.asnumpy(), [0, 2])
+    d = kept.asnumpy()
+    assert d[0, 0] == 1 and d[2, 2] == 3 and d[1, 1] == 0
+
+
+def test_csr_dot():
+    rs = np.random.RandomState(0)
+    A = rs.rand(5, 7).astype(np.float32) * (rs.rand(5, 7) > 0.6)
+    B = rs.randn(7, 3).astype(np.float32)
+    csr = sp.csr_matrix(A)
+    out = sp.dot(csr, mx.nd.array(B))
+    np.testing.assert_allclose(out.asnumpy(), A @ B, rtol=1e-5, atol=1e-6)
+    # transpose_a
+    C = rs.randn(5, 3).astype(np.float32)
+    outT = sp.dot(csr, mx.nd.array(C), transpose_a=True)
+    np.testing.assert_allclose(outT.asnumpy(), A.T @ C, rtol=1e-5, atol=1e-6)
+
+
+def test_rsp_add():
+    r1 = sp.row_sparse_array(np.array([[1, 1], [0, 0], [2, 2]], np.float32))
+    r2 = sp.row_sparse_array(np.array([[0, 0], [3, 3], [4, 4]], np.float32))
+    s = sp.elemwise_add(r1, r2)
+    assert s.stype == "row_sparse"
+    np.testing.assert_allclose(s.asnumpy(), [[1, 1], [3, 3], [6, 6]])
+
+
+def test_sparse_sgd_lazy_update():
+    w = mx.nd.array(np.ones((4, 2), np.float32))
+    grad = sp.row_sparse_array((np.array([[1.0, 1.0]], np.float32),
+                                np.array([2])), shape=(4, 2))
+    opt = mx.optimizer.SGD(learning_rate=0.5)
+    updater = mx.optimizer.get_updater(opt)
+    updater(0, grad, w)
+    out = w.asnumpy()
+    np.testing.assert_allclose(out[2], [0.5, 0.5])
+    np.testing.assert_allclose(out[[0, 1, 3]], 1.0)  # untouched rows
+
+
+def test_kvstore_sparse_push_pull():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.zeros((4, 2)))
+    g1 = sp.row_sparse_array((np.array([[1.0, 1.0]], np.float32),
+                              np.array([1])), shape=(4, 2))
+    g2 = sp.row_sparse_array((np.array([[2.0, 2.0]], np.float32),
+                              np.array([3])), shape=(4, 2))
+    kv.push("w", [g1, g2])
+    out = mx.nd.zeros((4, 2))
+    kv.pull("w", out=out)
+    v = out.asnumpy()
+    np.testing.assert_allclose(v[1], [1, 1])
+    np.testing.assert_allclose(v[3], [2, 2])
+    # row_sparse_pull of selected rows
+    rs_out = mx.nd.zeros((4, 2))
+    kv.row_sparse_pull("w", out=rs_out,
+                       row_ids=mx.nd.array(np.array([3], np.float32)))
+    v2 = rs_out.asnumpy()
+    np.testing.assert_allclose(v2[3], [2, 2])
+    assert v2[1].sum() == 0
+
+
+def test_sparse_linear_classification_end_to_end():
+    """BASELINE config 5: linear classifier on sparse features (ref:
+    benchmark/python/sparse/sparse_end2end.py semantics)."""
+    rs = np.random.RandomState(0)
+    n, d, k = 200, 50, 3
+    X = (rs.rand(n, d) * (rs.rand(n, d) > 0.8)).astype(np.float32)
+    w_true = rs.randn(d, k).astype(np.float32)
+    y = (X @ w_true).argmax(axis=1)
+    csr = sp.csr_matrix(X)
+
+    W = mx.nd.array(np.zeros((d, k), np.float32))
+    opt = mx.optimizer.SGD(learning_rate=2.0)
+    updater = mx.optimizer.get_updater(opt)
+    for _ in range(150):
+        logits = sp.dot(csr, W).asnumpy()
+        p = np.exp(logits - logits.max(1, keepdims=True))
+        p /= p.sum(1, keepdims=True)
+        p[np.arange(n), y] -= 1
+        gW = sp.dot(csr, mx.nd.array(p / n), transpose_a=True)
+        updater(0, gW, W)
+    acc = (sp.dot(csr, W).asnumpy().argmax(1) == y).mean()
+    assert acc > 0.85, acc
+
+
+def test_libsvm_iter(tmp_path):
+    fn = str(tmp_path / "data.libsvm")
+    with open(fn, "w") as f:
+        f.write("1 0:1.5 3:2.0\n0 1:1.0\n1 2:3.0 3:1.0\n0 0:0.5\n")
+    it = mx.io.LibSVMIter(data_libsvm=fn, data_shape=(4,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (2, 4)
+    np.testing.assert_allclose(batches[0].data[0].asnumpy()[0],
+                               [1.5, 0, 0, 2.0])
+
+
+def test_sparse_zeros():
+    z = sp.zeros("row_sparse", (3, 2))
+    assert z.asnumpy().sum() == 0
+    zc = sp.zeros("csr", (3, 2))
+    assert zc.asnumpy().sum() == 0
