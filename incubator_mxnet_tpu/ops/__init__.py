@@ -15,5 +15,7 @@ from . import init_ops    # noqa: F401  zeros/ones/arange/...
 from . import random_ops  # noqa: F401  samplers
 from . import optimizer_ops  # noqa: F401  fused updates
 from . import rnn         # noqa: F401  fused RNN + CTC
+from . import vision      # noqa: F401  detection/sampling (SSD/RCNN/STN)
+from . import attention   # noqa: F401  flash attention
 
 __all__ = ["Operator", "get_op", "list_ops", "register", "alias"]

@@ -1,0 +1,164 @@
+"""Attention kernels: Pallas flash attention + reference path.
+
+The reference framework predates transformer attention entirely (SURVEY
+§2.4: sequence handling = bucketing + fused RNN). These kernels are the
+*new capability* SURVEY §7 phase 11 mandates: long-context attention that
+maps onto the MXU with O(seq) memory.
+
+* ``flash_attention`` — tiled online-softmax attention as a Pallas TPU
+  kernel (one (block_q × d) Q tile resident in VMEM; K/V streamed in
+  block_k tiles; running max/sum rescaling). Grid = (batch*heads,
+  seq_q/block_q); the K loop is a fori_loop inside the kernel so the MXU
+  sees back-to-back (block_q×d)·(d×block_k) matmuls.
+* On non-TPU backends (the CPU test mesh) the same math runs as jnp — the
+  kernel is numerics-identical by construction and tested against it.
+* Registered as op ``_contrib_FlashAttention`` so both eager NDArray code
+  and Symbol graphs can call it (one registry, two modes).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# reference (jnp) attention — also the CPU path and the vjp recompute
+# ---------------------------------------------------------------------------
+
+def _attention_reference(q, k, v, causal=False, scale=None):
+    """(B, H, Sq, D), (B, H, Sk, D) → (B, H, Sq, D)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(probs.dtype)
+                      ).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash kernel
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sk, causal, scale,
+                  block_q):
+    from jax.experimental import pallas as pl
+    q = q_ref[0].astype(jnp.float32) * scale              # (bq, d)
+    bq, d = q.shape
+    num_kb = sk // block_k
+    q_blk = pl.program_id(1)
+
+    def body(i, carry):
+        acc, m_prev, l_prev = carry
+        k_blk = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k_blk.T                                    # (bq, bk)
+        if causal:
+            q_pos = q_blk * block_q + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = i * block_k + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_prev * alpha + p.sum(axis=-1)
+        acc = acc * alpha[:, None] + p @ v_blk
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc, m, l = lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-20)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward_pallas(q, k, v, causal, scale, block_q=128, block_k=128):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+    qf = q.reshape(B * H, Sq, D)
+    kf = k.reshape(B * H, Sk, D)
+    vf = v.reshape(B * H, Sk, D)
+    grid = (B * H, Sq // block_q)
+    kernel = functools.partial(_flash_kernel, block_k=block_k, sk=Sk,
+                               causal=causal, scale=scale, block_q=block_q)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * H * Sq * Sk * D,
+            bytes_accessed=(qf.size + kf.size + vf.size) * 4,
+            transcendentals=B * H * Sq * Sk),
+    )(qf, kf, vf)
+    return out.reshape(B, H, Sq, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal=False, scale=None):
+    """softmax(QKᵀ·scale)·V with O(seq) memory.
+
+    Pallas kernel on TPU; numerics-identical jnp path elsewhere. Backward
+    recomputes attention (flash-style rematerialization) instead of storing
+    the (Sq×Sk) probability matrix.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if jax.default_backend() == "tpu" and q.shape[2] % 128 == 0 and \
+            k.shape[2] % 128 == 0 and q.shape[-1] % 128 == 0:
+        return _flash_forward_pallas(q, k, v, causal, scale)
+    return _attention_reference(q, k, v, causal, scale)
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    out = flash_attention(q, k, v, causal, scale)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, res, g):
+    q, k, v = res
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def f(q_, k_, v_):
+        return _attention_reference(q_, k_, v_, causal, scale)
+
+    _, vjp_fn = jax.vjp(f, q, k, v)
+    return vjp_fn(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+@register("_contrib_FlashAttention", num_inputs=3,
+          aliases=("flash_attention", "_contrib_DotProductAttention"))
+def _flash_attention_op(q, k, v, causal=False, scale=None):
+    """Registered op wrapper — (B, H, S, D) inputs."""
+    return flash_attention(q, k, v, causal, scale)

@@ -1,0 +1,510 @@
+"""Vision / detection operators.
+
+TPU-native equivalents of the reference's custom-CUDA detection ops
+(SURVEY §2.2 vision row): ROIPooling (src/operator/roi_pooling.cu),
+MultiBoxPrior/Target/Detection (src/operator/contrib/multibox_*.cu),
+Proposal (src/operator/contrib/proposal.cu), BilinearSampler /
+GridGenerator / SpatialTransformer (src/operator/bilinear_sampler.cu,
+grid_generator.cc, spatial_transformer.cu), Correlation
+(src/operator/correlation.cu), Pad, box_nms (contrib/bounding_box.cc).
+
+Design: everything is static-shape, batched, branch-free — gathers and
+masked reductions instead of the reference's per-thread dynamic loops, so
+XLA can tile onto the TPU. NMS is the classic O(N²) masked iteration with a
+fixed trip count (`lax.fori_loop`), the standard TPU formulation.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_NEG = -1e30
+
+
+# ---------------------------------------------------------------------------
+# ROI pooling (ref: src/operator/roi_pooling.cc/.cu)
+# ---------------------------------------------------------------------------
+
+@register("ROIPooling", num_inputs=2, nograd_inputs=(1,))
+def _roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    """Max-pool each ROI to pooled_size (ref: roi_pooling.cc:roi 5-tuple
+    [batch_idx, x1, y1, x2, y2])."""
+    N, C, H, W = data.shape
+    R = rois.shape[0]
+    ph, pw = pooled_size
+
+    def one_roi(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = data[bi]                                   # (C, H, W)
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+
+        def pool_bin(py, px):
+            hstart = jnp.floor(y1 + py * bin_h)
+            hend = jnp.ceil(y1 + (py + 1) * bin_h)
+            wstart = jnp.floor(x1 + px * bin_w)
+            wend = jnp.ceil(x1 + (px + 1) * bin_w)
+            ymask = (ys >= hstart) & (ys < hend) & (ys >= 0) & (ys < H)
+            xmask = (xs >= wstart) & (xs < wend) & (xs >= 0) & (xs < W)
+            mask = ymask[:, None] & xmask[None, :]
+            masked = jnp.where(mask[None], img, _NEG)
+            val = masked.max(axis=(1, 2))
+            return jnp.where(mask.any(), val, 0.0)
+
+        py = jnp.arange(ph)
+        px = jnp.arange(pw)
+        out = jax.vmap(lambda y: jax.vmap(lambda x: pool_bin(y, x))(px))(py)
+        return jnp.transpose(out, (2, 0, 1))             # (C, ph, pw)
+
+    return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# MultiBox family (SSD; ref: src/operator/contrib/multibox_*.cc/.cu)
+# ---------------------------------------------------------------------------
+
+@register("MultiBoxPrior", num_inputs=1, differentiable=False,
+          aliases=("_contrib_MultiBoxPrior",))
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor boxes for one feature map (ref: multibox_prior.cc). Output
+    (1, H*W*(num_sizes+num_ratios-1), 4) in corner format, normalized."""
+    H, W = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
+    # anchors: sizes[0] with all ratios + other sizes with ratio 1
+    ws, hs = [], []
+    for r in ratios:
+        ws.append(sizes[0] * np.sqrt(r))
+        hs.append(sizes[0] / np.sqrt(r))
+    for s in sizes[1:]:
+        ws.append(s * np.sqrt(ratios[0]))
+        hs.append(s / np.sqrt(ratios[0]))
+    ws = jnp.asarray(ws, jnp.float32) / 2
+    hs = jnp.asarray(hs, jnp.float32) / 2
+    A = ws.shape[0]
+    cxg, cyg = jnp.meshgrid(cx, cy)                     # (H, W)
+    cxg = cxg.reshape(-1, 1)
+    cyg = cyg.reshape(-1, 1)
+    boxes = jnp.stack([cxg - ws, cyg - hs, cxg + ws, cyg + hs], axis=-1)
+    boxes = boxes.reshape(1, H * W * A, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+def _box_iou_corner(a, b):
+    """IoU matrix between (N,4) and (M,4) corner boxes."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("MultiBoxTarget", num_inputs=3, differentiable=False,
+          num_outputs=3, aliases=("_contrib_MultiBoxTarget",))
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """Assign anchors to ground truth (ref: multibox_target.cc). label is
+    (B, M, 5) [cls, x1, y1, x2, y2] padded with -1 rows. Returns
+    (loc_target (B, 4A), loc_mask (B, 4A), cls_target (B, A))."""
+    anchors = anchor.reshape(-1, 4)
+    A = anchors.shape[0]
+    var = jnp.asarray(variances, jnp.float32)
+
+    def per_sample(lab):
+        valid = lab[:, 0] >= 0                          # (M,)
+        gt = lab[:, 1:5]
+        iou = _box_iou_corner(anchors, gt)              # (A, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = iou.argmax(axis=1)                    # (A,)
+        best_iou = iou.max(axis=1)
+        # force-match: each valid gt claims its best anchor
+        best_anchor = iou.argmax(axis=0)                # (M,)
+        forced = jnp.zeros((A,), bool).at[best_anchor].set(valid)
+        pos = forced | (best_iou >= overlap_threshold)
+        matched_gt = gt[best_gt]                        # (A, 4)
+        cls = jnp.where(pos, lab[best_gt, 0] + 1, 0.0)  # 0 = background
+        # encode offsets (center form, variance-scaled)
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        gw = jnp.maximum(matched_gt[:, 2] - matched_gt[:, 0], 1e-8)
+        gh = jnp.maximum(matched_gt[:, 3] - matched_gt[:, 1], 1e-8)
+        gcx = (matched_gt[:, 0] + matched_gt[:, 2]) / 2
+        gcy = (matched_gt[:, 1] + matched_gt[:, 3]) / 2
+        tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / var[0]
+        ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / var[1]
+        tw = jnp.log(gw / jnp.maximum(aw, 1e-8)) / var[2]
+        th = jnp.log(gh / jnp.maximum(ah, 1e-8)) / var[3]
+        loc_t = jnp.stack([tx, ty, tw, th], axis=-1)    # (A, 4)
+        loc_t = jnp.where(pos[:, None], loc_t, 0.0)
+        loc_m = jnp.broadcast_to(pos[:, None], (A, 4)).astype(jnp.float32)
+        return loc_t.reshape(-1), loc_m.reshape(-1), cls
+
+    loc_target, loc_mask, cls_target = jax.vmap(per_sample)(label)
+    return loc_target, loc_mask, cls_target
+
+
+def _nms_fixed(boxes, scores, iou_threshold, max_out):
+    """Static-shape NMS: iteratively pick max-score box, suppress overlaps.
+    Returns indices (max_out,) with -1 padding."""
+    N = boxes.shape[0]
+    iou = _box_iou_corner(boxes, boxes)
+
+    def body(i, state):
+        alive_scores, picked = state
+        best = jnp.argmax(alive_scores)
+        best_score = alive_scores[best]
+        valid = best_score > _NEG / 2
+        picked = picked.at[i].set(jnp.where(valid, best, -1))
+        suppress = iou[best] >= iou_threshold
+        new_scores = jnp.where(suppress, _NEG, alive_scores)
+        new_scores = new_scores.at[best].set(_NEG)
+        return (jnp.where(valid, new_scores, alive_scores), picked)
+
+    picked0 = jnp.full((max_out,), -1, jnp.int32)
+    _, picked = lax.fori_loop(0, max_out, body, (scores, picked0))
+    return picked
+
+
+@register("MultiBoxDetection", num_inputs=3, differentiable=False,
+          aliases=("_contrib_MultiBoxDetection",))
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                        background_id=0, nms_threshold=0.5, force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + NMS (ref: multibox_detection.cc). Returns (B, A, 6)
+    [cls_id, score, x1, y1, x2, y2], suppressed rows cls_id=-1."""
+    B, num_cls, A = cls_prob.shape
+    anchors = anchor.reshape(-1, 4)
+    var = jnp.asarray(variances, jnp.float32)
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    topk = A if nms_topk <= 0 else min(nms_topk, A)
+
+    def per_sample(probs, loc):
+        loc = loc.reshape(-1, 4)
+        cx = loc[:, 0] * var[0] * aw + acx
+        cy = loc[:, 1] * var[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * var[2]) * aw / 2
+        h = jnp.exp(loc[:, 3] * var[3]) * ah / 2
+        boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # per-anchor best foreground class
+        fg = jnp.concatenate([probs[:background_id],
+                              probs[background_id + 1:]], axis=0)
+        cls_id = fg.argmax(axis=0)                      # (A,) in fg space
+        score = fg.max(axis=0)
+        cls_id = jnp.where(cls_id >= background_id, cls_id + 1, cls_id) - 1 \
+            if background_id == 0 else cls_id
+        score = jnp.where(score > threshold, score, _NEG)
+        keep = _nms_fixed(boxes, score, nms_threshold, topk)
+        out = jnp.full((A, 6), -1.0)
+        rows = jnp.arange(topk)
+        sel = jnp.maximum(keep, 0)
+        valid = keep >= 0
+        entries = jnp.concatenate(
+            [cls_id[sel][:, None].astype(jnp.float32),
+             jnp.where(score[sel] > _NEG / 2, score[sel], 0.0)[:, None],
+             boxes[sel]], axis=1)
+        entries = jnp.where(valid[:, None], entries, -1.0)
+        out = out.at[rows].set(entries)
+        return out
+
+    return jax.vmap(per_sample)(cls_prob, loc_pred)
+
+
+@register("box_nms", num_inputs=1, differentiable=False,
+          aliases=("_contrib_box_nms",))
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+             score_index=1, id_index=-1, force_suppress=True, in_format="corner",
+             out_format="corner"):
+    """Generic NMS over (..., N, K) box tensors (ref: contrib/bounding_box.cc)."""
+    shape = data.shape
+    flat = data.reshape(-1, shape[-2], shape[-1])
+    N = shape[-2]
+    max_out = N if topk <= 0 else min(topk, N)
+
+    def per_batch(d):
+        boxes = d[:, coord_start:coord_start + 4]
+        if in_format == "center":
+            cx, cy, w, h = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+            boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                              axis=-1)
+        scores = d[:, score_index]
+        scores = jnp.where(scores > valid_thresh, scores, _NEG)
+        keep = _nms_fixed(boxes, scores, overlap_thresh, max_out)
+        out = jnp.full_like(d, -1.0)
+        sel = jnp.maximum(keep, 0)
+        valid = keep >= 0
+        rows = jnp.arange(max_out)
+        out = out.at[rows].set(jnp.where(valid[:, None], d[sel], -1.0))
+        return out
+
+    return jax.vmap(per_batch)(flat).reshape(shape)
+
+
+@register("Proposal", num_inputs=3, differentiable=False,
+          aliases=("_contrib_Proposal", "_contrib_MultiProposal",
+                   "MultiProposal"))
+def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+              scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+              output_score=False, iou_loss=False):
+    """RPN proposal generation (ref: contrib/proposal.cc). Returns
+    (B*post_nms, 5) rois [batch_idx, x1, y1, x2, y2]."""
+    B, A2, H, W = cls_prob.shape
+    A = A2 // 2
+    base = feature_stride
+    # base anchors centered in the first stride cell (ref: proposal.cc
+    # GenerateAnchors)
+    anchors = []
+    ctr = (base - 1) / 2.0
+    for r in ratios:
+        w0 = np.round(np.sqrt(base * base / r))
+        h0 = np.round(w0 * r)
+        for s in scales:
+            ws, hs = w0 * s, h0 * s
+            anchors.append([ctr - (ws - 1) / 2, ctr - (hs - 1) / 2,
+                            ctr + (ws - 1) / 2, ctr + (hs - 1) / 2])
+    base_anchors = jnp.asarray(anchors, jnp.float32)     # (A, 4)
+    sx = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    sy = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    shift_x, shift_y = jnp.meshgrid(sx, sy)
+    shifts = jnp.stack([shift_x.ravel(), shift_y.ravel(),
+                        shift_x.ravel(), shift_y.ravel()], axis=1)
+    all_anchors = (base_anchors[None] + shifts[:, None]).reshape(-1, 4)
+    n_total = all_anchors.shape[0]
+
+    def per_sample(probs, deltas, info):
+        # fg scores, anchor-minor layout to match all_anchors (HW, A)
+        scores = probs[A:].transpose(1, 2, 0).reshape(-1)
+        d = deltas.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = all_anchors[:, 2] - all_anchors[:, 0] + 1
+        ah = all_anchors[:, 3] - all_anchors[:, 1] + 1
+        acx = all_anchors[:, 0] + 0.5 * (aw - 1)
+        acy = all_anchors[:, 1] + 0.5 * (ah - 1)
+        cx = d[:, 0] * aw + acx
+        cy = d[:, 1] * ah + acy
+        w = jnp.exp(jnp.clip(d[:, 2], -10, 10)) * aw
+        h = jnp.exp(jnp.clip(d[:, 3], -10, 10)) * ah
+        boxes = jnp.stack([cx - 0.5 * (w - 1), cy - 0.5 * (h - 1),
+                           cx + 0.5 * (w - 1), cy + 0.5 * (h - 1)], axis=-1)
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, info[1] - 1),
+                           jnp.clip(boxes[:, 1], 0, info[0] - 1),
+                           jnp.clip(boxes[:, 2], 0, info[1] - 1),
+                           jnp.clip(boxes[:, 3], 0, info[0] - 1)], axis=-1)
+        ws = boxes[:, 2] - boxes[:, 0] + 1
+        hs = boxes[:, 3] - boxes[:, 1] + 1
+        min_size = rpn_min_size * info[2]
+        scores = jnp.where((ws >= min_size) & (hs >= min_size), scores, _NEG)
+        pre = min(rpn_pre_nms_top_n, n_total)
+        top_scores, order = lax.top_k(scores, pre)
+        top_boxes = boxes[order]
+        keep = _nms_fixed(top_boxes, top_scores, threshold,
+                          rpn_post_nms_top_n)
+        sel = jnp.maximum(keep, 0)
+        valid = keep >= 0
+        out_boxes = jnp.where(valid[:, None], top_boxes[sel], 0.0)
+        out_scores = jnp.where(valid, top_scores[sel], 0.0)
+        return out_boxes, out_scores
+
+    boxes, scores = jax.vmap(per_sample)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(B, dtype=jnp.float32),
+                           rpn_post_nms_top_n)
+    rois = jnp.concatenate([batch_idx[:, None], boxes.reshape(-1, 4)], axis=1)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois
+
+
+# ---------------------------------------------------------------------------
+# Sampling ops (ref: bilinear_sampler.cc, grid_generator.cc,
+# spatial_transformer.cc)
+# ---------------------------------------------------------------------------
+
+def _bilinear_gather(img, gx, gy):
+    """Bilinear sample img (C,H,W) at pixel coords gx,gy (Ho,Wo)."""
+    C, H, W = img.shape
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    x1 = x0 + 1
+    y1 = y0 + 1
+    wx1 = gx - x0
+    wy1 = gy - y0
+    wx0 = 1 - wx1
+    wy0 = 1 - wy1
+
+    def at(y, x):
+        inb = (x >= 0) & (x <= W - 1) & (y >= 0) & (y <= H - 1)
+        xi = jnp.clip(x, 0, W - 1).astype(jnp.int32)
+        yi = jnp.clip(y, 0, H - 1).astype(jnp.int32)
+        v = img[:, yi, xi]                              # (C, Ho, Wo)
+        return jnp.where(inb[None], v, 0.0)
+
+    return (at(y0, x0) * (wy0 * wx0)[None] + at(y0, x1) * (wy0 * wx1)[None] +
+            at(y1, x0) * (wy1 * wx0)[None] + at(y1, x1) * (wy1 * wx1)[None])
+
+
+@register("BilinearSampler", num_inputs=2)
+def _bilinear_sampler(data, grid):
+    """ref: bilinear_sampler.cc — grid (B, 2, Ho, Wo) in [-1, 1]."""
+    B, C, H, W = data.shape
+
+    def one(img, g):
+        gx = (g[0] + 1) * (W - 1) / 2
+        gy = (g[1] + 1) * (H - 1) / 2
+        return _bilinear_gather(img, gx, gy)
+
+    return jax.vmap(one)(data, grid)
+
+
+@register("GridGenerator", num_inputs=1)
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """ref: grid_generator.cc — affine (B,6) → sampling grid (B,2,H,W),
+    or warp (B,2,H,W) flow → grid."""
+    if transform_type == "affine":
+        B = data.shape[0]
+        H, W = target_shape
+        xs = jnp.linspace(-1, 1, W)
+        ys = jnp.linspace(-1, 1, H)
+        gx, gy = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, HW)
+
+        def one(theta):
+            t = theta.reshape(2, 3)
+            out = t @ coords                            # (2, HW)
+            return out.reshape(2, H, W)
+
+        return jax.vmap(one)(data)
+    # warp: data is flow (B, 2, H, W) in pixels
+    B, _, H, W = data.shape
+    xs = jnp.arange(W, dtype=jnp.float32)
+    ys = jnp.arange(H, dtype=jnp.float32)
+    gx, gy = jnp.meshgrid(xs, ys)
+    nx = (gx[None] + data[:, 0]) * 2 / max(W - 1, 1) - 1
+    ny = (gy[None] + data[:, 1]) * 2 / max(H - 1, 1) - 1
+    return jnp.stack([nx, ny], axis=1)
+
+
+@register("SpatialTransformer", num_inputs=2)
+def _spatial_transformer(data, loc, target_shape=(0, 0),
+                         transform_type="affine", sampler_type="bilinear",
+                         cudnn_off=False):
+    """ref: spatial_transformer.cc — affine loc net + bilinear sampling."""
+    grid = _grid_generator(loc, "affine", target_shape)
+    return _bilinear_sampler(data, grid)
+
+
+@register("Correlation", num_inputs=2)
+def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                 stride2=1, pad_size=0, is_multiply=True):
+    """ref: correlation.cc — patch cross-correlation between two feature
+    maps (FlowNet)."""
+    B, C, H, W = data1.shape
+    d = max_displacement
+    pad = pad_size
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    outs = []
+    for dy in range(-d, d + 1, stride2):
+        for dx in range(-d, d + 1, stride2):
+            shifted = jnp.roll(p2, (-dy, -dx), axis=(2, 3))
+            if is_multiply:
+                prod = (p1 * shifted).mean(axis=1)
+            else:
+                prod = -jnp.abs(p1 - shifted).mean(axis=1)
+            outs.append(prod)
+    out = jnp.stack(outs, axis=1)
+    if pad:
+        out = out[:, :, pad:-pad, pad:-pad]
+    return out[:, :, ::stride1, ::stride1]
+
+
+@register("Pad", num_inputs=1)
+def _pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    """ref: src/operator/pad.cc — constant/edge/reflect padding."""
+    pw = [(int(pad_width[2 * i]), int(pad_width[2 * i + 1]))
+          for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(data, pw, constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(data, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(data, pw, mode="reflect")
+    raise ValueError("unknown pad mode %r" % mode)
+
+
+@register("Crop", num_inputs=None)
+def _crop(*inputs, offset=(0, 0), h_w=(0, 0), num_args=1, center_crop=False):
+    """ref: src/operator/crop.cc — crop first input to shape of second (or
+    h_w)."""
+    data = inputs[0]
+    if num_args == 2 and len(inputs) > 1:
+        th, tw = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        th, tw = h_w
+    H, W = data.shape[2], data.shape[3]
+    if center_crop:
+        y0 = (H - th) // 2
+        x0 = (W - tw) // 2
+    else:
+        y0, x0 = offset
+    return data[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+@register("ROIAlign", num_inputs=2, nograd_inputs=(1,),
+          aliases=("_contrib_ROIAlign",))
+def _roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
+               sample_ratio=2):
+    """ROI Align (bilinear, no quantization) — modern companion to
+    ROIPooling; the reference era used ROIPooling, Mask-RCNN needs this."""
+    N, C, H, W = data.shape
+    ph, pw = pooled_size
+    sr = max(int(sample_ratio), 1)
+
+    def one_roi(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = (roi[1] * spatial_scale, roi[2] * spatial_scale,
+                          roi[3] * spatial_scale, roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        rw = jnp.maximum(x2 - x1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = data[bi]
+        # sample sr×sr points per bin, average
+        iy = jnp.arange(ph * sr, dtype=jnp.float32)
+        ix = jnp.arange(pw * sr, dtype=jnp.float32)
+        gy = y1 + (iy + 0.5) * bin_h / sr
+        gx = x1 + (ix + 0.5) * bin_w / sr
+        gxx, gyy = jnp.meshgrid(gx, gy)
+        vals = _bilinear_gather(img, gxx, gyy)          # (C, ph*sr, pw*sr)
+        vals = vals.reshape(C, ph, sr, pw, sr).mean(axis=(2, 4))
+        return vals
+
+    return jax.vmap(one_roi)(rois)

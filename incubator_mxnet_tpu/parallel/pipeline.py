@@ -1,0 +1,94 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the "pp" axis.
+
+The reference has no explicit pipeline scheduler — its async engine
+dataflow-pipelines model-parallel graphs implicitly (SURVEY §2.4 row
+'Pipeline parallelism'). TPU-natively the schedule must be explicit and
+static: each mesh "pp" device holds one stage's parameters; activations hop
+stage→stage via ``ppermute`` over ICI; the (num_micro + num_stages - 1)-step
+loop is a ``lax.fori_loop`` so XLA overlaps the hop with the next
+microbatch's compute.
+
+Constraints (standard for this formulation): every stage maps activations
+of one shape to the same shape (transformer-block-like), and
+num_microbatches ≥ 1.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+__all__ = ["pipeline_apply"]
+
+
+def _pp_body(params, xs, stage_fn, axis_name):
+    """Per-device body. params: this stage's params (leading pp axis already
+    split away by shard_map). xs: (n_micro, ...) microbatches — only stage
+    0 reads them; outputs: (n_micro, ...) — only the last stage's are real."""
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    params = jax.tree.map(lambda p: p[0], params)   # drop stacked pp dim
+    n_micro = xs.shape[0]
+    T = n_micro + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    mb_shape = xs.shape[1:]
+    received = jnp.zeros(mb_shape, xs.dtype)
+    outputs = jnp.zeros_like(xs)
+
+    def step(t, carry):
+        received, outputs = carry
+        inject = lax.dynamic_index_in_dim(
+            xs, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False)
+        act_in = jnp.where(idx == 0, inject, received)
+        act_out = stage_fn(params, act_in)
+        # last stage records its result for microbatch t-(n-1)
+        out_slot = jnp.clip(t - (n - 1), 0, n_micro - 1)
+        record = (idx == n - 1) & (t >= n - 1)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(record,
+                      act_out,
+                      lax.dynamic_index_in_dim(outputs, out_slot, 0,
+                                               keepdims=False)),
+            out_slot, axis=0)
+        received = lax.ppermute(act_out, axis_name, perm)
+        return received, outputs
+
+    _, outputs = lax.fori_loop(0, T, step, (received, outputs))
+    # broadcast last stage's outputs to every device (so out_specs can be
+    # replicated over pp)
+    outputs = lax.psum(jnp.where(idx == n - 1, outputs, 0.0), axis_name)
+    return outputs
+
+
+def pipeline_apply(stage_fn, stacked_params, x, mesh, axis="pp",
+                   num_microbatches=None):
+    """Run x through num_stages stages, stage i using stacked_params[...][i].
+
+    stacked_params: pytree whose leaves have a leading axis of size
+    mesh.shape[axis] (one slice per stage). x: (batch, ...) global input.
+    Returns (batch, ...) output of the final stage.
+    """
+    n_stages = mesh.shape[axis]
+    if num_microbatches is None:
+        num_microbatches = n_stages
+    B = x.shape[0]
+    assert B % num_microbatches == 0, \
+        "batch %d not divisible into %d microbatches" % (B, num_microbatches)
+    mb = B // num_microbatches
+    xs = x.reshape((num_microbatches, mb) + x.shape[1:])
+
+    p_spec = jax.tree.map(lambda _: P(axis), stacked_params)
+    fn = shard_map(
+        functools.partial(_pp_body, stage_fn=stage_fn, axis_name=axis),
+        mesh=mesh,
+        in_specs=(p_spec, P()),
+        out_specs=P(),
+        check_vma=False)
+    out = fn(stacked_params, xs)
+    return out.reshape((B,) + out.shape[2:])

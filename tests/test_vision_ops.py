@@ -1,0 +1,160 @@
+"""Vision/detection op tests (parity model: tests/python/unittest/
+test_operator.py ROI/multibox/sampler sections)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+
+def test_roi_pooling():
+    data = mx.nd.array(np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8))
+    rois = mx.nd.array(np.array([[0, 0, 0, 7, 7]], np.float32))
+    out = mx.nd.ROIPooling(data, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    # max of each quadrant
+    np.testing.assert_allclose(out.asnumpy()[0, 0],
+                               [[27, 31], [59, 63]])
+
+
+def test_roi_align():
+    data = mx.nd.array(np.ones((1, 2, 8, 8), np.float32))
+    rois = mx.nd.array(np.array([[0, 1, 1, 5, 5]], np.float32))
+    out = mx.nd.ROIAlign(data, rois, pooled_size=(3, 3), spatial_scale=1.0)
+    assert out.shape == (1, 2, 3, 3)
+    np.testing.assert_allclose(out.asnumpy(), 1.0, rtol=1e-5)
+
+
+def test_multibox_prior():
+    data = mx.nd.zeros((1, 8, 4, 4))
+    anchors = mx.nd.MultiBoxPrior(data, sizes=(0.5, 0.25), ratios=(1, 2))
+    # num anchors per pixel = sizes + ratios - 1 = 3
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()[0]
+    # first anchor centered at (0.125, 0.125) with size 0.5
+    np.testing.assert_allclose(a[0], [0.125 - 0.25, 0.125 - 0.25,
+                                      0.125 + 0.25, 0.125 + 0.25], atol=1e-6)
+
+
+def test_multibox_target():
+    anchors = mx.nd.array(np.array([[[0.0, 0.0, 0.5, 0.5],
+                                     [0.5, 0.5, 1.0, 1.0]]], np.float32))
+    # one gt box matching the second anchor
+    label = mx.nd.array(np.array([[[1, 0.5, 0.5, 1.0, 1.0],
+                                   [-1, 0, 0, 0, 0]]], np.float32))
+    cls_pred = mx.nd.zeros((1, 3, 2))
+    loc_t, loc_m, cls_t = mx.nd.MultiBoxTarget(anchors, label, cls_pred)
+    ct = cls_t.asnumpy()[0]
+    assert ct[1] == 2.0  # class 1 → target 2 (0 is background)
+    assert ct[0] == 0.0
+    lm = loc_m.asnumpy()[0].reshape(2, 4)
+    assert lm[1].sum() == 4 and lm[0].sum() == 0
+
+
+def test_multibox_detection():
+    anchors = mx.nd.array(np.array([[[0.1, 0.1, 0.4, 0.4],
+                                     [0.6, 0.6, 0.9, 0.9]]], np.float32))
+    cls_prob = mx.nd.array(np.array([[[0.1, 0.8],     # background
+                                      [0.9, 0.1],     # class 0
+                                      [0.0, 0.1]]], np.float32))
+    loc_pred = mx.nd.zeros((1, 8))
+    out = mx.nd.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                  nms_threshold=0.5)
+    det = out.asnumpy()[0]
+    kept = det[det[:, 0] >= 0]
+    assert len(kept) >= 1
+    assert kept[0][0] == 0.0  # class 0 detection
+    np.testing.assert_allclose(kept[0][2:], [0.1, 0.1, 0.4, 0.4], atol=1e-5)
+
+
+def test_box_nms():
+    boxes = np.array([[[0.9, 0.0, 0.0, 1.0, 1.0],
+                       [0.8, 0.05, 0.05, 1.0, 1.0],   # overlaps first
+                       [0.7, 2.0, 2.0, 3.0, 3.0]]], np.float32)
+    data = mx.nd.array(boxes)
+    out = mx.nd.box_nms(data, overlap_thresh=0.5, coord_start=1,
+                        score_index=0)
+    v = out.asnumpy()[0]
+    assert v[0][0] == pytest.approx(0.9)
+    assert v[1][0] == pytest.approx(0.7)  # second suppressed, third kept
+    assert v[2][0] == -1.0
+
+
+def test_proposal():
+    B, A, H, W = 1, 2, 4, 4  # A must equal len(scales) * len(ratios)
+    rs = np.random.RandomState(0)
+    cls_prob = mx.nd.array(rs.rand(B, 2 * A, H, W).astype(np.float32))
+    bbox_pred = mx.nd.array((rs.randn(B, 4 * A, H, W) * 0.1).astype(np.float32))
+    im_info = mx.nd.array(np.array([[64, 64, 1.0]], np.float32))
+    rois = mx.nd.Proposal(cls_prob, bbox_pred, im_info,
+                          rpn_pre_nms_top_n=12, rpn_post_nms_top_n=5,
+                          feature_stride=16, scales=(2, 4), ratios=(1.0,))
+    assert rois.shape == (5, 5)
+    v = rois.asnumpy()
+    assert (v[:, 0] == 0).all()
+    assert (v[:, 1:] >= 0).all() and (v[:, 1:] <= 64).all()
+
+
+def test_bilinear_sampler_identity():
+    data = mx.nd.array(np.random.RandomState(0).randn(1, 2, 5, 5)
+                       .astype(np.float32))
+    xs = np.linspace(-1, 1, 5, dtype=np.float32)
+    gx, gy = np.meshgrid(xs, xs)
+    grid = mx.nd.array(np.stack([gx, gy])[None])
+    out = mx.nd.BilinearSampler(data, grid)
+    np.testing.assert_allclose(out.asnumpy(), data.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_spatial_transformer_identity():
+    data = mx.nd.array(np.random.RandomState(1).randn(2, 3, 6, 6)
+                       .astype(np.float32))
+    theta = mx.nd.array(np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32),
+                                (2, 1)))
+    out = mx.nd.SpatialTransformer(data, theta, target_shape=(6, 6),
+                                   transform_type="affine",
+                                   sampler_type="bilinear")
+    np.testing.assert_allclose(out.asnumpy(), data.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_grid_generator_warp():
+    flow = mx.nd.zeros((1, 2, 4, 4))
+    grid = mx.nd.GridGenerator(flow, transform_type="warp")
+    g = grid.asnumpy()[0]
+    np.testing.assert_allclose(g[0, 0], np.linspace(-1, 1, 4), atol=1e-6)
+
+
+def test_correlation_self():
+    data = mx.nd.array(np.ones((1, 4, 6, 6), np.float32))
+    out = mx.nd.Correlation(data, data, max_displacement=1, pad_size=1)
+    assert out.shape[1] == 9  # (2d+1)^2 displacement channels
+
+
+def test_pad():
+    x = mx.nd.array(np.ones((1, 1, 2, 2), np.float32))
+    out = mx.nd.Pad(x, mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1),
+                    constant_value=5.0)
+    assert out.shape == (1, 1, 4, 4)
+    v = out.asnumpy()[0, 0]
+    assert v[0, 0] == 5 and v[1, 1] == 1
+
+
+def test_crop():
+    x = mx.nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    like = mx.nd.zeros((1, 1, 2, 2))
+    out = mx.nd.Crop(x, like, num_args=2, center_crop=True)
+    np.testing.assert_allclose(out.asnumpy()[0, 0], [[5, 6], [9, 10]])
+
+
+def test_bilinear_sampler_grad():
+    from incubator_mxnet_tpu import autograd
+    data = mx.nd.array(np.random.RandomState(0).randn(1, 1, 4, 4)
+                       .astype(np.float32))
+    data.attach_grad()
+    xs = np.linspace(-0.9, 0.9, 4, dtype=np.float32)
+    gx, gy = np.meshgrid(xs, xs)
+    grid = mx.nd.array(np.stack([gx, gy])[None])
+    with autograd.record():
+        out = mx.nd.BilinearSampler(data, grid)
+    out.backward()
+    assert np.abs(data.grad.asnumpy()).sum() > 0
