@@ -265,14 +265,25 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
         out = _csr_matmul(data, col, row, r, lhs.shape[0])
         return NDArray(out, ctx=lhs._ctx)
     if isinstance(lhs, RowSparseNDArray) and not isinstance(rhs, BaseSparseNDArray):
+        if transpose_a or transpose_b:
+            # no transposed rsp kernel (parity: dot-inl.h only dispatches
+            # csr for transposed sparse dots) — densify rather than be wrong
+            return dot(NDArray(lhs.todense()._read(), ctx=lhs._ctx), rhs,
+                       transpose_a=transpose_a, transpose_b=transpose_b)
         # rsp @ dense: dense rows gather-matmul, scatter into result
         idx = lhs.indices._read().astype(jnp.int32)
         out = jnp.zeros((lhs.shape[0], rhs.shape[1]), lhs.data._read().dtype)
         out = out.at[idx].set(lhs.data._read() @ rhs._read())
         return NDArray(out, ctx=lhs._ctx)
+    if isinstance(rhs, RowSparseNDArray):
+        # dense @ rsp has no sparse kernel either way — densify rhs
+        return dot(lhs, NDArray(rhs.todense()._read(), ctx=rhs._ctx),
+                   transpose_a=transpose_a, transpose_b=transpose_b)
     if isinstance(rhs, BaseSparseNDArray):
-        # dense @ csr: (csrᵀ @ denseᵀ)ᵀ
-        return NDArray(dot(rhs, NDArray(lhs._read().T, ctx=lhs._ctx),
+        # op(dense) @ op(csr) = (op(csr)ᵀ @ op(dense)ᵀ)ᵀ; op(dense)ᵀ is
+        # lhs itself when transpose_a is set, lhsᵀ otherwise
+        lt = lhs._read() if transpose_a else lhs._read().T
+        return NDArray(dot(rhs, NDArray(lt, ctx=lhs._ctx),
                            transpose_a=not transpose_b)._read().T,
                        ctx=lhs._ctx)
     from .ndarray import invoke

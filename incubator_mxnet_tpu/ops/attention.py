@@ -53,8 +53,8 @@ def _attention_reference(q, k, v, causal=False, scale=None):
 # Pallas flash kernel
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sk, causal, scale,
-                  block_q):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sq, sk, causal,
+                  scale, block_q):
     from jax.experimental import pallas as pl
     q = q_ref[0].astype(jnp.float32) * scale              # (bq, d)
     bq, d = q.shape
@@ -65,19 +65,27 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sk, causal, scale,
         acc, m_prev, l_prev = carry
         k_blk = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
         v_blk = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        s = q @ k_blk.T                                    # (bq, bk)
+        # full f32 MXU passes — the default matmul precision on TPU is bf16,
+        # which is not acceptable for softmax logits
+        s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            precision=lax.Precision.HIGHEST)   # (bq, bk)
         if causal:
+            # query row r may see keys up to r + (sk - sq): the diagonal is
+            # anchored at the *end* of the key axis, matching the jnp path's
+            # tril(k=sk-sq) — essential for KV-cache decode where Sq != Sk
             q_pos = q_blk * block_q + lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
             k_pos = i * block_k + lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = jnp.where(q_pos + (sk - sq) >= k_pos, s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])
         l_new = l_prev * alpha + p.sum(axis=-1)
-        acc = acc * alpha[:, None] + p @ v_blk
+        acc = acc * alpha[:, None] + lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST)
         return acc, m_new, l_new
 
     acc0 = jnp.zeros((bq, d), jnp.float32)
@@ -93,32 +101,39 @@ def _flash_forward_pallas(q, k, v, causal, scale, block_q=128, block_k=128):
 
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
+    # MXU lanes want D in multiples of 128; typical head dims (64, 96) get
+    # zero-padded — padded Q columns contribute nothing to QKᵀ and padded V
+    # columns produce output columns we slice off
+    Dp = -(-D // 128) * 128
+    if Dp != D:
+        pad = [(0, 0)] * 3 + [(0, Dp - D)]
+        q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
     block_q = min(block_q, Sq)
     block_k = min(block_k, Sk)
-    qf = q.reshape(B * H, Sq, D)
-    kf = k.reshape(B * H, Sk, D)
-    vf = v.reshape(B * H, Sk, D)
+    qf = q.reshape(B * H, Sq, Dp)
+    kf = k.reshape(B * H, Sk, Dp)
+    vf = v.reshape(B * H, Sk, Dp)
     grid = (B * H, Sq // block_q)
-    kernel = functools.partial(_flash_kernel, block_k=block_k, sk=Sk,
+    kernel = functools.partial(_flash_kernel, block_k=block_k, sq=Sq, sk=Sk,
                                causal=causal, scale=scale, block_q=block_q)
     out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Sk, Dp), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk, Dp), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        out_specs=pl.BlockSpec((1, block_q, Dp), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, Dp), q.dtype),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=64 * 1024 * 1024),
         cost_estimate=pl.CostEstimate(
-            flops=4 * B * H * Sq * Sk * D,
+            flops=4 * B * H * Sq * Sk * Dp,
             bytes_accessed=(qf.size + kf.size + vf.size) * 4,
             transcendentals=B * H * Sq * Sk),
     )(qf, kf, vf)
-    return out.reshape(B, H, Sq, D)
+    return out.reshape(B, H, Sq, Dp)[..., :D]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -132,26 +147,99 @@ def flash_attention(q, k, v, causal=False, scale=None):
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if jax.default_backend() == "tpu" and q.shape[2] % 128 == 0 and \
-            k.shape[2] % 128 == 0 and q.shape[-1] % 128 == 0:
+            k.shape[2] % 128 == 0:
         return _flash_forward_pallas(q, k, v, causal, scale)
     return _attention_reference(q, k, v, causal, scale)
 
 
+def _kv_block_size(sk):
+    """Largest power-of-two K-chunk ≤1024 dividing sk (else no chunking)."""
+    for b in (1024, 512, 256, 128, 64):
+        if sk % b == 0:
+            return b
+    return sk
+
+
 def _flash_fwd(q, k, v, causal, scale):
     out = flash_attention(q, k, v, causal, scale)
-    return out, (q, k, v)
+    return out, (q, k, v, out)
 
 
 def _flash_bwd(causal, scale, res, g):
-    q, k, v = res
+    """Flash-style backward: two chunked passes over the key axis, never
+    materializing the (Sq × Sk) score matrix — backward memory matches the
+    forward's O(Sq · block) profile.
+
+    Pass 1 recovers the softmax log-normalizer with an online max/sum scan;
+    pass 2 rebuilds each probability tile from (logits − lse) and
+    accumulates dQ (carried) and per-tile dK/dV (scan outputs).
+    """
+    q, k, v = res[0], res[1], res[2]
+    out = res[3]
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    dtype_in = q.dtype
+    Sq, Sk = q.shape[2], k.shape[2]
+    block = _kv_block_size(Sk)
+    nb = Sk // block
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    kb = k.astype(jnp.float32).reshape(*k.shape[:2], nb, block, k.shape[-1])
+    vb = v.astype(jnp.float32).reshape(*v.shape[:2], nb, block, v.shape[-1])
+    kb = jnp.moveaxis(kb, 2, 0)                       # (nb, B, H, blk, D)
+    vb = jnp.moveaxis(vb, 2, 0)
+    q_pos = jnp.arange(Sq)[:, None] + (Sk - Sq)       # diag anchored at end
 
-    def f(q_, k_, v_):
-        return _attention_reference(q_, k_, v_, causal, scale)
+    hi = jax.lax.Precision.HIGHEST  # bf16 MXU passes would desync p from out
 
-    _, vjp_fn = jax.vjp(f, q, k, v)
-    return vjp_fn(g)
+    def scores(k_blk, i):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk, precision=hi,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = i * block + jnp.arange(block)[None, :]
+            mask = q_pos >= k_pos
+            return jnp.where(mask, s, _NEG_INF), mask
+        return s, None
+
+    def stat_step(carry, xs):
+        m_prev, l_prev = carry
+        k_blk, i = xs
+        s, _ = scores(k_blk, i)
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        l_new = l_prev * jnp.exp(m_prev - m_new) + \
+            jnp.exp(s - m_new[..., None]).sum(axis=-1)
+        return (m_new, l_new), None
+
+    m0 = jnp.full(q.shape[:3], _NEG_INF, jnp.float32)
+    l0 = jnp.zeros(q.shape[:3], jnp.float32)
+    (m, l), _ = lax.scan(stat_step, (m0, l0), (kb, jnp.arange(nb)))
+    # keep (m, l) separate: folding into m + log(l) loses log(l) to float
+    # absorption when m is the -1e30 sentinel (rows with no visible keys)
+    l_inv = 1.0 / jnp.maximum(l, 1e-20)
+    delta = (gf * out.astype(jnp.float32)).sum(-1)    # (B, H, Sq)
+
+    def grad_step(dq_acc, xs):
+        k_blk, v_blk, i = xs
+        s, mask = scores(k_blk, i)
+        p = jnp.exp(s - m[..., None]) * l_inv[..., None]
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, gf, precision=hi)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, v_blk, precision=hi)
+        ds = p * (dp - delta[..., None]) * scale
+        if mask is not None:
+            # masked logits are constants in the forward (`where` routes the
+            # gradient around them), so they carry no dQ/dK — matters for
+            # rows with no visible keys, where p is uniform, not 0
+            ds = jnp.where(mask, ds, 0.0)
+        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk,
+                                     precision=hi)
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf, precision=hi)
+        return dq_acc, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dq, (dk, dv) = lax.scan(grad_step, dq0, (kb, vb, jnp.arange(nb)))
+    dk = jnp.moveaxis(dk, 0, 2).reshape(k.shape)
+    dv = jnp.moveaxis(dv, 0, 2).reshape(v.shape)
+    return (dq.astype(dtype_in), dk.astype(k.dtype), dv.astype(v.dtype))
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -593,17 +593,24 @@ class Updater(object):
     def sync_state_context(self, state, context):
         if isinstance(state, NDArray):
             return state.as_in_context(context)
+        if isinstance(state, np.ndarray):
+            # states loaded via set_states arrive as numpy — rehydrate so
+            # the fused update ops can read them
+            return _nd_mod.array(state, ctx=context)
         if isinstance(state, (tuple, list)):
             return type(state)(self.sync_state_context(i, context) for i in state)
         return state
 
     def set_states(self, states):
-        """ref: optimizer.py Updater.set_states (pickle format)."""
+        """ref: optimizer.py Updater.set_states (pickle format).
+
+        Loaded leaves stay numpy until first use — sync_state_context
+        rehydrates them as NDArrays on the weight's context lazily.
+        """
         states = pickle.loads(states)
         if isinstance(states, tuple) and len(states) == 2:
-            self.states, self.optimizer = states
-        else:
-            self.states = states
+            states, self.optimizer = states
+        self.states = dict(states)
         self.states_synced = dict.fromkeys(self.states.keys(), False)
 
     def get_states(self, dump_optimizer=False):

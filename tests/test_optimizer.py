@@ -134,3 +134,21 @@ def test_lr_wd_mult():
     assert opt._get_lr(1) == 1.0
     # bias gets wd_mult 0 by the reference heuristic
     assert opt._get_wd(1) == 0.0
+
+
+def test_updater_update_after_state_load():
+    """States arrive as numpy after set_states — the next update must still
+    run (round-1 advisor: set_states never rehydrated NDArrays)."""
+    opt = mx.optimizer.Adam(learning_rate=0.1)
+    updater = mx.optimizer.get_updater(opt)
+    w = mx.nd.array(np.ones((3,), np.float32))
+    g = mx.nd.array(np.full((3,), 0.5, np.float32))
+    updater(0, g, w)
+    blob = updater.get_states(dump_optimizer=True)
+
+    w2 = mx.nd.array(w.asnumpy())
+    u2 = mx.optimizer.get_updater(mx.optimizer.Adam())
+    u2.set_states(blob)
+    u2(0, g, w2)  # must not crash on numpy states
+    updater(0, g, w)
+    np.testing.assert_allclose(w2.asnumpy(), w.asnumpy(), rtol=1e-6)

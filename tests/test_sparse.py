@@ -140,3 +140,20 @@ def test_sparse_zeros():
     assert z.asnumpy().sum() == 0
     zc = sp.zeros("csr", (3, 2))
     assert zc.asnumpy().sum() == 0
+
+
+def test_dense_sparse_dot_transpose_a():
+    """dense(op) @ csr honoring transpose_a (round-1 advisor finding)."""
+    rs = np.random.RandomState(3)
+    A = rs.randn(4, 5).astype(np.float32)
+    B = rs.rand(4, 6).astype(np.float32) * (rs.rand(4, 6) > 0.5)
+    csr = sp.csr_matrix(B)
+    out = sp.dot(mx.nd.array(A), csr, transpose_a=True)
+    np.testing.assert_allclose(out.asnumpy(), A.T @ B, rtol=1e-5, atol=1e-6)
+    A2 = rs.randn(3, 4).astype(np.float32)
+    out2 = sp.dot(mx.nd.array(A2), csr)
+    np.testing.assert_allclose(out2.asnumpy(), A2 @ B, rtol=1e-5, atol=1e-6)
+    # transpose_b as well: dense @ csrᵀ
+    A3 = rs.randn(3, 6).astype(np.float32)
+    out3 = sp.dot(mx.nd.array(A3), csr, transpose_b=True)
+    np.testing.assert_allclose(out3.asnumpy(), A3 @ B.T, rtol=1e-5, atol=1e-6)
