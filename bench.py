@@ -5,13 +5,18 @@ Prints ONE JSON line:
 
 Baseline: the reference's published single-GPU ResNet-50 train number,
 batch 32 — 90.74 img/s on M40 (docs/faq/perf.md:174; the K80 row is 45.52).
-We benchmark the same workload (ResNet-50, batch 32, synthetic ImageNet
-shapes) as one fused XLA train step (forward+loss+backward+SGD update) via
-parallel.DataParallelTrainer on whatever single chip is available.
+Same workload (ResNet-50, synthetic ImageNet shapes), run the TPU-native
+way: ONE fused XLA train step (forward+loss+backward+SGD update) via
+parallel.DataParallelTrainer, bf16 compute with f32 master weights
+(mixed precision, reference mp_sgd semantics), batch 256.
+
+The final sync is a host fetch of the last step's loss — the donated
+parameter chain makes it depend on every step, so the measured time is
+true end-to-end wall clock (block_until_ready alone does not reliably
+synchronize through the axon device tunnel).
 """
 import json
 import os
-import sys
 import time
 
 import numpy as np
@@ -26,7 +31,8 @@ def main():
     from incubator_mxnet_tpu.gluon.model_zoo import vision
     from incubator_mxnet_tpu.parallel import make_mesh, DataParallelTrainer
 
-    batch = 32
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     mx.random.seed(0)
     net = vision.resnet50_v1(classes=1000)
     net.initialize(mx.init.Xavier())
@@ -36,22 +42,24 @@ def main():
         net, gluon.loss.SoftmaxCrossEntropyLoss(),
         optimizer="sgd", optimizer_params={"learning_rate": 0.1,
                                            "momentum": 0.9},
-        mesh=mesh)
+        mesh=mesh, dtype=None if dtype in ("float32", "none") else dtype)
 
     rs = np.random.RandomState(0)
     x = mx.nd.array(rs.rand(batch, 3, 224, 224).astype(np.float32))
     y = mx.nd.array((rs.rand(batch) * 1000).astype(np.float32))
 
-    # warmup (compile)
-    for _ in range(2):
-        trainer.step(x, y).block_until_ready()
+    # warmup (compile); sync before the timed region starts
+    for _ in range(3):
+        loss = trainer.step(x, y)
+    float(np.asarray(loss))
 
-    n_steps = int(os.environ.get("BENCH_STEPS", "10"))
+    n_steps = int(os.environ.get("BENCH_STEPS", "20"))
     t0 = time.perf_counter()
     for _ in range(n_steps):
         loss = trainer.step(x, y)
-    loss.block_until_ready()
+    final = float(np.asarray(loss))  # host fetch = true sync point
     dt = time.perf_counter() - t0
+    assert np.isfinite(final), "bench loss went non-finite"
 
     img_s = n_steps * batch / dt
     print(json.dumps({

@@ -230,15 +230,30 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0
     red = tuple(i for i in range(data.ndim) if i != (axis % data.ndim))
     bshape = tuple(data.shape[axis % data.ndim] if i == axis % data.ndim else 1
                    for i in range(data.ndim))
+    # statistics in f32 always: bf16/fp16 variance loses catastrophically to
+    # cancellation, and the moving averages must stay full precision
+    xf = data.astype(jnp.float32)
     if is_train and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        # One fused pass over the activation: E[x-c] and E[(x-c)²] reduce
+        # together (jnp.var would re-read the tensor).  Shifting by the
+        # running mean keeps the E[y²]−E[y]² form safe from catastrophic
+        # cancellation when a channel's |mean| ≫ std.
+        shift = lax.stop_gradient(moving_mean.astype(jnp.float32)
+                                  ).reshape(bshape)
+        xs = xf - shift
+        s1 = jnp.mean(xs, axis=red)
+        s2 = jnp.mean(jnp.square(xs), axis=red)
+        mean = s1 + shift.reshape(s1.shape)
+        var = jnp.maximum(s2 - jnp.square(s1), 0.0)
     else:
-        mean, var = moving_mean, moving_var
+        mean = moving_mean.astype(jnp.float32)
+        var = moving_var.astype(jnp.float32)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     inv = lax.rsqrt(var + eps)
-    out = (data - mean.reshape(bshape)) * (g * inv).reshape(bshape) + beta.reshape(bshape)
-    return out, mean, var
+    out = (xf - mean.reshape(bshape)) * \
+        (g.astype(jnp.float32) * inv).reshape(bshape) + \
+        beta.astype(jnp.float32).reshape(bshape)
+    return out.astype(data.dtype), mean, var
 
 
 @register("LayerNorm", num_inputs=3, input_names=("data", "gamma", "beta"),

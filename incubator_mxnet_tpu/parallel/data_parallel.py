@@ -91,7 +91,14 @@ class DataParallelTrainer(object):
     """One-jit data-parallel trainer for a Gluon HybridBlock."""
 
     def __init__(self, block, loss, optimizer="sgd", optimizer_params=None,
-                 mesh=None, donate=True):
+                 mesh=None, donate=True, dtype=None):
+        """``dtype='bfloat16'`` enables mixed precision: parameters and the
+        optimizer stay in f32 master copies; activations and weights are
+        cast to bf16 *inside* the jitted step (XLA fuses the casts into the
+        convs/matmuls, which then run native bf16 MXU passes); the loss is
+        computed in f32.  Same semantics as the reference's mp_sgd
+        multi-precision path (src/operator/optimizer_op.cc mp_* ops), but
+        the master/compute split lives in the one fused program."""
         self.block = block
         self.loss = loss
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
@@ -100,6 +107,9 @@ class DataParallelTrainer(object):
         self._opt_init, self._opt_update = pure_optimizer(optimizer,
                                                           **optimizer_params)
         self._donate = donate
+        self._compute_dtype = jnp.dtype(dtype) if dtype is not None else None
+        self._rng_key = None       # device-resident, carried through the step
+        self._lr_dev = None        # cached device copy of the learning rate
         self._params = None        # name -> jax array (device-resident)
         self._opt_state = None
         self._trainable = None
@@ -126,31 +136,53 @@ class DataParallelTrainer(object):
                            for n in self._trainable}
 
     def sync_params(self):
-        """Write device params back into the Block (checkpoint/export path)."""
+        """Write device params back into the Block (checkpoint/export path).
+
+        Mesh-sharded buffers are pulled to host first: Block params must be
+        plain single-device arrays so eager eval/save work regardless of
+        the trainer's mesh.
+        """
         blk_params = self.block.collect_params()
         for name, v in self._params.items():
-            blk_params[name].data()._write(v)
+            blk_params[name].data()._write(jnp.asarray(jax.device_get(v)))
 
     # -- the pure step -----------------------------------------------------
     def _make_step(self, train=True):
         block, loss_blk = self.block, self.loss
         trainable = list(self._trainable)
         opt_update = self._opt_update
+        cdt = self._compute_dtype
 
         def forward_loss(trainable_vals, frozen_vals, x, y, rng):
             all_vals = dict(frozen_vals)
-            all_vals.update(trainable_vals)
+            if cdt is not None:
+                # compute-dtype cast happens inside the differentiated fn so
+                # grads arrive back in f32 (cast transpose = cast back).
+                # Only *trainable* params are cast: frozen values include BN
+                # running stats, which must never be re-quantized to bf16
+                # (the momentum blend would drift them every step)
+                all_vals.update({n: v.astype(cdt)
+                                 if v.dtype == jnp.float32 else v
+                                 for n, v in trainable_vals.items()})
+                x = x.astype(cdt) if x.dtype == jnp.float32 else x
+            else:
+                all_vals.update(trainable_vals)
             shadows = {n: NDArray(v) for n, v in all_vals.items()}
             ndx, ndy = NDArray(x), NDArray(y)
             with random_state.use_key(rng):
                 with autograd._scope(recording=False, training=train):
                     with block._trace_params(shadows):
                         out = block.hybrid_forward_dispatch(ndx)
+                    if cdt is not None:
+                        out = NDArray(out._read().astype(jnp.float32))
                     per_sample = loss_blk(out, ndy)
             aux = {n: s._read() for n, s in shadows.items() if s._version > 0}
             return jnp.mean(per_sample._read()), aux
 
-        def step(params, opt_state, x, y, rng, lr):
+        def step(params, opt_state, rng_key, x, y, lr):
+            # rng key lives on device across steps: split here, return the
+            # next key — no host RNG round trip per step
+            next_key, rng = jax.random.split(rng_key)
             tvals = {n: params[n] for n in trainable}
             fvals = {n: v for n, v in params.items() if n not in tvals}
             (loss_val, aux), grads = jax.value_and_grad(
@@ -164,7 +196,7 @@ class DataParallelTrainer(object):
             for n, v in aux.items():
                 if n not in tvals:
                     new_params[n] = v.astype(new_params[n].dtype)
-            return new_params, new_opt, loss_val
+            return new_params, new_opt, next_key, loss_val
 
         return step
 
@@ -179,9 +211,9 @@ class DataParallelTrainer(object):
             step = self._make_step(train=True)
             self._jit_cache[key] = jax.jit(
                 step,
-                in_shardings=(repl, repl, batch, batch, repl, repl),
-                out_shardings=(repl, repl, repl),
-                donate_argnums=(0, 1) if self._donate else ())
+                in_shardings=(repl, repl, repl, batch, batch, repl),
+                out_shardings=(repl, repl, repl, repl),
+                donate_argnums=(0, 1, 2) if self._donate else ())
         return self._jit_cache[key]
 
     def step(self, data, label):
@@ -189,16 +221,24 @@ class DataParallelTrainer(object):
         x = data._read() if isinstance(data, NDArray) else jnp.asarray(data)
         y = label._read() if isinstance(label, NDArray) else jnp.asarray(label)
         fn = self.compile(x, y)
-        # shard the batch onto the mesh (H2D + slice per device); params are
-        # already mesh-resident from _gather_params / the previous step
-        batch_sh = NamedSharding(self.mesh, P("dp"))
         repl = NamedSharding(self.mesh, P())
-        x = jax.device_put(x, batch_sh)
-        y = jax.device_put(y, batch_sh)
-        rng = jax.device_put(random_state.next_key(), repl)
-        self._params, self._opt_state, loss_val = fn(
-            self._params, self._opt_state, x, y, rng,
-            jax.device_put(jnp.asarray(self._lr, jnp.float32), repl))
+        batch_sh = NamedSharding(self.mesh, P("dp"))
+        if self._rng_key is None:
+            self._rng_key = jax.device_put(random_state.next_key(), repl)
+        if self._lr_dev is None:
+            self._lr_dev = jax.device_put(jnp.asarray(self._lr, jnp.float32),
+                                          repl)
+        # reshard x/y only when needed: an array already laid out batch-wise
+        # (e.g. the previous step's input buffer) skips the placement round
+        # trip entirely
+        if not (hasattr(x, "sharding")
+                and x.sharding.is_equivalent_to(batch_sh, x.ndim)):
+            x = jax.device_put(x, batch_sh)
+        if not (hasattr(y, "sharding")
+                and y.sharding.is_equivalent_to(batch_sh, y.ndim)):
+            y = jax.device_put(y, batch_sh)
+        self._params, self._opt_state, self._rng_key, loss_val = fn(
+            self._params, self._opt_state, self._rng_key, x, y, self._lr_dev)
         return loss_val
 
     @property
@@ -207,3 +247,4 @@ class DataParallelTrainer(object):
 
     def set_learning_rate(self, lr):
         self._lr = lr
+        self._lr_dev = None  # re-upload on next step

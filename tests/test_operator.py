@@ -273,3 +273,21 @@ def test_l2_normalization():
     out = mx.nd.L2Normalization(_nd(x), mode="instance")
     ref = x / np.sqrt((x ** 2).sum(axis=(1, 2), keepdims=True) + 1e-10)
     assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_large_mean_variance():
+    """Shifted one-pass variance must survive |mean| >> std channels
+    (round-2 review: naive E[x^2]-E[x]^2 cancels catastrophically)."""
+    rs = np.random.RandomState(0)
+    x = (rs.randn(64, 4, 3, 3) * 0.03 + 1000.0).astype(np.float32)
+    gamma = mx.nd.array(np.ones(4, np.float32))
+    beta = mx.nd.array(np.zeros(4, np.float32))
+    mmean = mx.nd.array(np.full(4, 1000.0, np.float32))
+    mvar = mx.nd.array(np.ones(4, np.float32))
+    with mx.autograd.record():
+        out = mx.nd.BatchNorm(mx.nd.array(x), gamma, beta, mmean, mvar,
+                              fix_gamma=False)
+    got = out.asnumpy()
+    want = (x - x.mean(axis=(0, 2, 3), keepdims=True)) / \
+        np.sqrt(x.var(axis=(0, 2, 3), keepdims=True) + 1e-3)
+    np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-2)

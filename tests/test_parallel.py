@@ -188,3 +188,54 @@ def test_flash_attention_grads_match_reference(sq, sk, causal):
     for got, want in zip(vjp(g), ref_vjp(g)):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-3, atol=1e-4)
+
+
+def test_mixed_precision_matches_f32():
+    """bf16 compute + f32 masters tracks the f32 loss curve (reference
+    mp_sgd semantics, src/operator/optimizer_op.cc mp_* ops)."""
+    def build():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Conv2D(8, kernel_size=3, padding=1))
+            net.add(nn.BatchNorm())
+            net.add(nn.Activation("relu"))
+            net.add(nn.GlobalAvgPool2D())
+            net.add(nn.Flatten())
+            net.add(nn.Dense(4))
+        return net
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(16, 3, 8, 8).astype(np.float32)
+    y = (rs.rand(16) * 4).astype(np.float32)
+    losses = {}
+    for dt in (None, "bfloat16"):
+        mx.random.seed(0)
+        net = build()
+        net.initialize(mx.init.Xavier())
+        tr = DataParallelTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            mesh=make_mesh({"dp": 8}), dtype=dt)
+        losses[dt] = [float(tr.step(mx.nd.array(x), mx.nd.array(y)))
+                      for _ in range(8)]
+    assert losses["bfloat16"][-1] < losses["bfloat16"][0]  # it learns
+    np.testing.assert_allclose(losses[None], losses["bfloat16"], atol=0.05)
+
+
+def test_sync_params_then_eager_eval():
+    """sync_params must leave Block params usable by eager single-device
+    forward (mesh-sharded buffers pulled to host first)."""
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    tr = DataParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                             optimizer="sgd",
+                             optimizer_params={"learning_rate": 0.1},
+                             mesh=make_mesh({"dp": 8}))
+    x = mx.nd.array(np.ones((8, 6), np.float32))
+    y = mx.nd.array(np.zeros(8, np.float32))
+    tr.step(x, y)
+    tr.sync_params()
+    out = net(mx.nd.array(np.ones((2, 6), np.float32)))
+    assert out.shape == (2, 4)
