@@ -22,7 +22,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-__all__ = ["pipeline_apply"]
+__all__ = ["pipeline_apply", "pipeline_train_step", "make_pipeline_trainer"]
 
 
 def _pp_body(params, xs, stage_fn, axis_name):
@@ -92,3 +92,42 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, axis="pp",
         check_vma=False)
     out = fn(stacked_params, xs)
     return out.reshape((B,) + out.shape[2:])
+
+
+def pipeline_train_step(stage_fn, stacked_params, x, y, loss_fn, mesh,
+                        axis="pp", num_microbatches=None):
+    """One pipeline *training* step: microbatched forward through the
+    stages, loss on the last stage's output, backward re-traversing the
+    schedule in reverse (the transpose of each ``ppermute`` hop is the
+    opposite hop, so gradient activations ride the ring backwards), with
+    gradient accumulation across microbatches falling out of the loop
+    transpose.  Returns ``(loss, grads)`` with ``grads`` shaped like
+    ``stacked_params`` (leading stage axis).
+
+    The reference has no pipeline scheduler to mirror (SURVEY §2.4); this
+    is the capability mandated by SURVEY §7 phase 11.
+    """
+
+    def objective(params):
+        out = pipeline_apply(stage_fn, params, x, mesh, axis=axis,
+                             num_microbatches=num_microbatches)
+        return jnp.mean(loss_fn(out, y))
+
+    return jax.value_and_grad(objective)(stacked_params)
+
+
+def make_pipeline_trainer(stage_fn, loss_fn, mesh, axis="pp",
+                          num_microbatches=None, learning_rate=0.01):
+    """Jitted GPipe SGD trainer: returns ``train(params, x, y) ->
+    (params, loss)`` with stage-sharded donated params."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train(params, x, y):
+        loss, grads = pipeline_train_step(stage_fn, params, x, y, loss_fn,
+                                          mesh, axis=axis,
+                                          num_microbatches=num_microbatches)
+        params = jax.tree.map(lambda p, g: p - learning_rate * g,
+                              params, grads)
+        return params, loss
+
+    return train
