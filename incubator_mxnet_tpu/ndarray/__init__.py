@@ -94,5 +94,7 @@ _register.module_surface = sys.modules[__name__]
 # expose submodule-style accessors for parity: nd.random, nd.linalg
 from . import random  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401
+from . import contrib  # noqa: E402,F401
 
 NDArray = NDArray  # re-export for clarity

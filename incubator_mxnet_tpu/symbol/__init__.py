@@ -53,3 +53,7 @@ def trace_to_symbol(x):
 
 
 _register.populate(sys.modules[__name__].__dict__)
+
+# sub-namespaces for parity: sym.linalg, sym.contrib
+from . import linalg  # noqa: E402,F401
+from . import contrib  # noqa: E402,F401

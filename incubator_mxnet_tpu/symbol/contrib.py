@@ -1,0 +1,15 @@
+"""``sym.contrib`` namespace — short names over the ``_contrib_*`` ops.
+
+Parity: python/mxnet/symbol/contrib.py.
+"""
+from __future__ import annotations
+
+from ..ops.registry import _REGISTRY
+from .register import make_sym_func
+
+__all__ = []
+for _name, _op in list(_REGISTRY.items()):
+    if _name.startswith("_contrib_"):
+        _short = _name[len("_contrib_"):]
+        globals()[_short] = make_sym_func(_short, _op)
+        __all__.append(_short)

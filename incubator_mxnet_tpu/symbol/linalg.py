@@ -1,0 +1,16 @@
+"""``sym.linalg`` namespace — short names over the ``_linalg_*`` op family.
+
+Parity: python/mxnet/symbol/linalg.py.
+"""
+from __future__ import annotations
+
+from ..ops.registry import get_op
+from .register import make_sym_func
+
+_OPS = ("gemm", "gemm2", "potrf", "potri", "trmm", "trsm", "sumlogdiag",
+        "syrk", "gelqf", "syevd")
+
+for _n in _OPS:
+    globals()[_n] = make_sym_func(_n, get_op("_linalg_" + _n))
+
+__all__ = list(_OPS)

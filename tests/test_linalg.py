@@ -1,0 +1,163 @@
+"""Tests for the la_op family + FFT/count_sketch.
+
+Model: reference tests/python/unittest/test_operator.py test_laop* and
+check_numeric_gradient (python/mxnet/test_utils.py:792).
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _rand_spd(b, n):
+    rng = np.random.RandomState(7)
+    a = rng.randn(b, n, n).astype("float32")
+    return np.matmul(a, np.swapaxes(a, -1, -2)) + n * np.eye(n, dtype="float32")
+
+
+def test_gemm_gemm2():
+    rng = np.random.RandomState(0)
+    A = rng.randn(2, 3, 4).astype("float32")
+    B = rng.randn(2, 4, 5).astype("float32")
+    C = rng.randn(2, 3, 5).astype("float32")
+    out = nd.linalg.gemm(nd.array(A), nd.array(B), nd.array(C),
+                         alpha=2.0, beta=0.5)
+    assert_almost_equal(out.asnumpy(), 2.0 * np.matmul(A, B) + 0.5 * C, rtol=1e-4)
+    out2 = nd.linalg.gemm2(nd.array(A), nd.array(B.swapaxes(-1, -2)),
+                           transpose_b=True, alpha=3.0)
+    assert_almost_equal(out2.asnumpy(), 3.0 * np.matmul(A, B), rtol=1e-4)
+
+
+def test_potrf_potri_sumlogdiag():
+    A = _rand_spd(3, 4)
+    L = nd.linalg.potrf(nd.array(A))
+    assert_almost_equal(np.matmul(L.asnumpy(), L.asnumpy().swapaxes(-1, -2)),
+                        A, rtol=1e-3, atol=1e-3)
+    Ainv = nd.linalg.potri(L)
+    assert_almost_equal(np.matmul(Ainv.asnumpy(), A),
+                        np.broadcast_to(np.eye(4, dtype="float32"), A.shape),
+                        rtol=1e-2, atol=1e-2)
+    sld = nd.linalg.sumlogdiag(L)
+    assert_almost_equal(sld.asnumpy(),
+                        np.sum(np.log(np.diagonal(L.asnumpy(), axis1=-2, axis2=-1)), -1),
+                        rtol=1e-4)
+
+
+def test_trmm_trsm_roundtrip():
+    rng = np.random.RandomState(1)
+    Lnp = np.tril(rng.rand(2, 4, 4).astype("float32") + 1.0)
+    B = rng.randn(2, 4, 3).astype("float32")
+    prod = nd.linalg.trmm(nd.array(Lnp), nd.array(B), alpha=2.0)
+    back = nd.linalg.trsm(nd.array(Lnp), prod, alpha=0.5)
+    assert_almost_equal(back.asnumpy(), B, rtol=1e-3, atol=1e-3)
+    # rightside: X @ L^T
+    Br = rng.randn(2, 3, 4).astype("float32")
+    prod_r = nd.linalg.trmm(nd.array(Lnp), nd.array(Br), rightside=True,
+                            transpose=True)
+    assert_almost_equal(prod_r.asnumpy(),
+                        np.matmul(Br, Lnp.swapaxes(-1, -2)), rtol=1e-3, atol=1e-3)
+
+
+def test_syrk():
+    rng = np.random.RandomState(2)
+    A = rng.randn(2, 3, 5).astype("float32")
+    out = nd.linalg.syrk(nd.array(A), alpha=1.5)
+    assert_almost_equal(out.asnumpy(), 1.5 * np.matmul(A, A.swapaxes(-1, -2)),
+                        rtol=1e-4, atol=1e-4)
+    out_t = nd.linalg.syrk(nd.array(A), transpose=True)
+    assert_almost_equal(out_t.asnumpy(), np.matmul(A.swapaxes(-1, -2), A),
+                        rtol=1e-4, atol=1e-4)
+
+
+def test_gelqf():
+    rng = np.random.RandomState(3)
+    A = rng.randn(2, 3, 5).astype("float32")
+    Q, L = nd.linalg.gelqf(nd.array(A))
+    Qn, Ln = Q.asnumpy(), L.asnumpy()
+    assert_almost_equal(np.matmul(Ln, Qn), A, rtol=1e-3, atol=1e-3)
+    assert_almost_equal(np.matmul(Qn, Qn.swapaxes(-1, -2)),
+                        np.broadcast_to(np.eye(3, dtype="float32"), (2, 3, 3)),
+                        rtol=1e-3, atol=1e-3)
+    # L lower triangular with non-negative diagonal
+    assert np.allclose(Ln, np.tril(Ln), atol=1e-5)
+    assert (np.diagonal(Ln, axis1=-2, axis2=-1) >= -1e-5).all()
+
+
+def test_syevd():
+    A = _rand_spd(2, 5)
+    U, w = nd.linalg.syevd(nd.array(A))
+    Un, wn = U.asnumpy(), w.asnumpy()
+    # A = U^T diag(w) U, rows of U are eigenvectors
+    recon = np.matmul(Un.swapaxes(-1, -2) * wn[..., None, :], Un)
+    assert_almost_equal(recon, A, rtol=1e-2, atol=1e-2)
+    assert (np.diff(wn, axis=-1) >= -1e-4).all()  # ascending
+
+
+def test_linalg_grad():
+    """Numeric gradient through potrf+sumlogdiag (logdet) — the canonical
+    composite the la_op family exists for."""
+    from incubator_mxnet_tpu import autograd
+    A = _rand_spd(1, 3)
+    x = nd.array(A)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.linalg.sumlogdiag(nd.linalg.potrf(x))
+        y.backward()
+    # d logdet(A) / dA = A^{-1} (symmetrized halves for the factored path);
+    # check against finite differences instead of the closed form to stay
+    # convention-agnostic.
+    g = x.grad.asnumpy()
+    eps = 1e-2
+
+    def f(a):
+        import jax.numpy as jnp
+        import jax
+        L = jax.lax.linalg.cholesky(jnp.asarray(a))
+        return float(jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1))))
+
+    for i in range(3):
+        d = np.zeros_like(A)
+        d[0, i, i] = eps
+        fd = (f(A + d) - f(A - d)) / (2 * eps)
+        assert abs(fd - g[0, i, i]) < 1e-2, (i, fd, g[0, i, i])
+
+
+def test_fft_ifft_roundtrip():
+    rng = np.random.RandomState(4)
+    x = rng.randn(3, 8).astype("float32")
+    y = nd.contrib.fft(nd.array(x))
+    assert y.shape == (3, 16)
+    c = np.fft.fft(x, axis=-1)
+    inter = np.stack([c.real, c.imag], -1).reshape(3, 16).astype("float32")
+    assert_almost_equal(y.asnumpy(), inter, rtol=1e-3, atol=1e-3)
+    # unnormalized inverse: ifft(fft(x)) == N * x
+    back = nd.contrib.ifft(y)
+    assert_almost_equal(back.asnumpy(), 8.0 * x, rtol=1e-3, atol=1e-3)
+
+
+def test_count_sketch():
+    rng = np.random.RandomState(5)
+    n, d, k = 4, 6, 3
+    x = rng.randn(n, d).astype("float32")
+    h = rng.randint(0, k, size=(1, d)).astype("float32")
+    s = (rng.randint(0, 2, size=(1, d)) * 2 - 1).astype("float32")
+    out = nd.contrib.count_sketch(nd.array(x), nd.array(h), nd.array(s),
+                                  out_dim=k)
+    expect = np.zeros((n, k), dtype="float32")
+    for i in range(d):
+        expect[:, int(h[0, i])] += s[0, i] * x[:, i]
+    assert_almost_equal(out.asnumpy(), expect, rtol=1e-4, atol=1e-4)
+
+
+def test_linalg_symbolic():
+    """la_op family reachable from the Symbol surface with correct shapes."""
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = mx.sym.linalg.gemm2(a, b)
+    arg_shapes, out_shapes, _ = out.infer_shape(a=(2, 3, 4), b=(2, 4, 5))
+    assert out_shapes[0] == (2, 3, 5)
+    ex = out.bind(mx.cpu(), {"a": nd.ones((2, 3, 4)), "b": nd.ones((2, 4, 5))})
+    y = ex.forward()[0]
+    assert_almost_equal(y.asnumpy(), 4.0 * np.ones((2, 3, 5), "float32"))
