@@ -53,3 +53,4 @@ from . import module as mod  # alias, as in mxnet
 from . import model
 from . import gluon
 from . import parallel
+from . import contrib

@@ -1,0 +1,5 @@
+"""``mx.contrib`` — experimental / contributed subsystems.
+
+Parity: python/mxnet/contrib/__init__.py (quantization, onnx, text, ...).
+"""
+from . import quantization  # noqa: F401

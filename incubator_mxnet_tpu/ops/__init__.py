@@ -18,5 +18,6 @@ from . import rnn         # noqa: F401  fused RNN + CTC
 from . import vision      # noqa: F401  detection/sampling (SSD/RCNN/STN)
 from . import attention   # noqa: F401  flash attention
 from . import linalg      # noqa: F401  LAPACK la_op family + FFT/count_sketch
+from . import quantization  # noqa: F401  INT8 quantize/dequantize/quantized_*
 
 __all__ = ["Operator", "get_op", "list_ops", "register", "alias"]

@@ -183,19 +183,28 @@ def _pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad
         pads = [(p, p) for p in pad]
     padding = ((0, 0), (0, 0)) + tuple(pads)
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            # literal -inf: keeps XLA's select-and-scatter autodiff path
+            return lax.reduce_window(data, -jnp.inf, lax.max,
+                                     window, strides, padding)
+        init = jnp.asarray(jnp.iinfo(data.dtype).min, data.dtype)
         return lax.reduce_window(data, init, lax.max, window, strides, padding)
     if pool_type in ("avg", "sum"):
-        s = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
+        zero = (0.0 if jnp.issubdtype(data.dtype, jnp.floating)
+                else jnp.asarray(0, data.dtype))
+        s = lax.reduce_window(data, zero, lax.add, window, strides, padding)
         if pool_type == "sum":
             return s
         if count_include_pad:
             return s / float(np.prod(kernel))
         ones = jnp.ones(data.shape, data.dtype)
-        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        cnt = lax.reduce_window(ones, zero, lax.add, window, strides, padding)
         return s / cnt
     if pool_type == "lp":
-        s = lax.reduce_window(jnp.abs(data) ** p_value, 0.0, lax.add, window, strides, padding)
+        p = jnp.abs(data) ** p_value
+        zero = (0.0 if jnp.issubdtype(p.dtype, jnp.floating)
+                else jnp.asarray(0, p.dtype))
+        s = lax.reduce_window(p, zero, lax.add, window, strides, padding)
         return s ** (1.0 / p_value)
     raise ValueError("unknown pool_type %r" % pool_type)
 
@@ -386,7 +395,8 @@ def _custom_loss_fwd_bwd(fwd_fn, grad_fn):
     return f
 
 
-@register("SoftmaxOutput", num_inputs=2, nograd_inputs=(1,), aliases=("Softmax",))
+@register("SoftmaxOutput", num_inputs=2, nograd_inputs=(1,),
+          input_names=("data", "label"), aliases=("Softmax",))
 def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0, multi_output=False,
                     use_ignore=False, preserve_shape=False, normalization="null",
                     out_grad=False, smooth_alpha=0.0):
@@ -418,7 +428,8 @@ def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0, multi_output
     return _custom_loss_fwd_bwd(fwd_fn, grad_fn)(data, label)
 
 
-@register("LinearRegressionOutput", num_inputs=2, nograd_inputs=(1,))
+@register("LinearRegressionOutput", num_inputs=2, nograd_inputs=(1,),
+          input_names=("data", "label"))
 def _linear_regression_output(data, label, grad_scale=1.0):
     """ref: regression_output.cc — fwd identity, bwd (pred - label)."""
     return _custom_loss_fwd_bwd(
@@ -426,21 +437,24 @@ def _linear_regression_output(data, label, grad_scale=1.0):
         lambda d, l: (d - l.reshape(d.shape)) * grad_scale)(data, label)
 
 
-@register("MAERegressionOutput", num_inputs=2, nograd_inputs=(1,))
+@register("MAERegressionOutput", num_inputs=2, nograd_inputs=(1,),
+          input_names=("data", "label"))
 def _mae_regression_output(data, label, grad_scale=1.0):
     return _custom_loss_fwd_bwd(
         lambda d, l: d,
         lambda d, l: jnp.sign(d - l.reshape(d.shape)) * grad_scale)(data, label)
 
 
-@register("LogisticRegressionOutput", num_inputs=2, nograd_inputs=(1,))
+@register("LogisticRegressionOutput", num_inputs=2, nograd_inputs=(1,),
+          input_names=("data", "label"))
 def _logistic_regression_output(data, label, grad_scale=1.0):
     return _custom_loss_fwd_bwd(
         lambda d, l: jax.nn.sigmoid(d),
         lambda d, l: (jax.nn.sigmoid(d) - l.reshape(d.shape)) * grad_scale)(data, label)
 
 
-@register("SVMOutput", num_inputs=2, nograd_inputs=(1,))
+@register("SVMOutput", num_inputs=2, nograd_inputs=(1,),
+          input_names=("data", "label"))
 def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
                 use_linear=False):
     """ref: src/operator/svm_output.cc"""

@@ -1,0 +1,294 @@
+"""INT8 quantization operator family.
+
+TPU-native rebirth of src/operator/quantization/ (19 files):
+
+* ``_contrib_quantize`` / ``_contrib_dequantize`` / ``_contrib_requantize``
+  (ref: quantize-inl.h, dequantize-inl.h, requantize-inl.h) — the same
+  zero-centered int8 / affine uint8 schemes, as pure XLA element-wise code.
+* ``_contrib_quantized_conv`` / ``_contrib_quantized_fully_connected``
+  (ref: quantized_conv.cc, quantized_fully_connected.cc) — int8×int8→int32
+  compute.  Where the reference calls cuDNN's int8 conv (quantized_conv.cu),
+  we hand XLA int8 operands with ``preferred_element_type=int32`` so the
+  contraction runs natively on the MXU's int8 path — this is the op family
+  TPUs were built for.
+* ``_contrib_quantized_pooling`` / ``_contrib_quantized_flatten``
+  (ref: quantized_pooling.cc, quantized_flatten.cc) — shape/window ops that
+  stay in int8 and carry the (min, max) range through unchanged.
+
+Range convention (identical to the reference's quantization_utils.h):
+every quantized tensor travels as a triple ``(q, min_range, max_range)``
+where min/max are float32 scalars giving the real-valued range that the
+integer grid spans.  int8 is always zero-centered: the effective range is
+``[-r, r]`` with ``r = max(|min|, |max|)`` and scale ``127/r``.
+
+All ops here are inference-only (non-differentiable), as in the reference
+(quantization is applied to a trained model by the graph pass in
+contrib/quantization.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from .nn import _pooling, _conv_param_shapes, _fc_param_shapes
+
+_RANGE_NAMES = ("min_data", "max_data", "min_weight", "max_weight",
+                "min_bias", "max_bias")
+
+
+def _qconv_param_shapes(data_shape, params):
+    d = _conv_param_shapes(data_shape, params)
+    d.update({n: () for n in _RANGE_NAMES})
+    return d
+
+
+def _qfc_param_shapes(data_shape, params):
+    d = _fc_param_shapes(data_shape, params)
+    d.update({n: () for n in _RANGE_NAMES})
+    return d
+
+INT8_MAX = 127.0
+UINT8_MAX = 255.0
+
+
+def _real_range(min_r, max_r):
+    """Zero-centered effective range r such that int8 grid covers [-r, r].
+    Floored at a tiny epsilon so all-zero tensors quantize to 0, not NaN."""
+    return jnp.maximum(jnp.maximum(jnp.abs(min_r), jnp.abs(max_r)),
+                       jnp.float32(1e-30))
+
+
+def _quantize_int8(x, min_r, max_r):
+    r = _real_range(min_r, max_r)
+    scale = INT8_MAX / r
+    q = jnp.clip(jnp.rint(x.astype(jnp.float32) * scale), -INT8_MAX, INT8_MAX)
+    return q.astype(jnp.int8), -r, r
+
+
+@register("_contrib_quantize", num_inputs=3, num_outputs=3,
+          input_names=("data", "min_range", "max_range"),
+          differentiable=False)
+def _quantize(data, min_range, max_range, out_type="uint8"):
+    """Quantize float → int8/uint8 given the real range of the values.
+
+    ref: quantize-inl.h quantize_zero_centered (int8) /
+    quantize_unsigned (uint8).  Returns (quantized, out_min, out_max).
+    """
+    min_r = jnp.asarray(min_range, jnp.float32).reshape(())
+    max_r = jnp.asarray(max_range, jnp.float32).reshape(())
+    if out_type == "int8":
+        q, omin, omax = _quantize_int8(data, min_r, max_r)
+        return q, jnp.float32(1) * omin, jnp.float32(1) * omax
+    if out_type == "uint8":
+        scale = UINT8_MAX / jnp.maximum(max_r - min_r, jnp.float32(1e-30))
+        q = jnp.clip(jnp.rint((data.astype(jnp.float32) - min_r) * scale),
+                     0.0, UINT8_MAX).astype(jnp.uint8)
+        return q, min_r, max_r
+    raise ValueError("out_type must be int8 or uint8, got %r" % out_type)
+
+
+@register("_contrib_dequantize", num_inputs=3, num_outputs=1,
+          input_names=("data", "min_range", "max_range"),
+          differentiable=False)
+def _dequantize(data, min_range, max_range, out_type="float32"):
+    """int8/uint8 → float32 (ref: dequantize-inl.h)."""
+    min_r = jnp.asarray(min_range, jnp.float32).reshape(())
+    max_r = jnp.asarray(max_range, jnp.float32).reshape(())
+    if data.dtype == jnp.int8:
+        r = _real_range(min_r, max_r)
+        return data.astype(jnp.float32) * (r / INT8_MAX)
+    if data.dtype == jnp.uint8:
+        return data.astype(jnp.float32) * ((max_r - min_r) / UINT8_MAX) + min_r
+    # int32 accumulators (out of quantized conv/fc before requantize)
+    r = _real_range(min_r, max_r)
+    return data.astype(jnp.float32) * (r / float(np.iinfo(np.int32).max))
+
+
+@register("_contrib_requantize", num_inputs=3, num_outputs=3,
+          input_names=("data", "min_range", "max_range"),
+          differentiable=False)
+def _requantize(data, min_range, max_range,
+                min_calib_range=None, max_calib_range=None):
+    """int32 accumulator → int8 with a narrower range (ref: requantize-inl.h).
+
+    With a calibrated range (set by the graph pass after calibration) the
+    rescale factor is static; without one the range is computed from the
+    data at runtime (the reference's "calib_mode=none" slow path).
+    """
+    min_r = jnp.asarray(min_range, jnp.float32).reshape(())
+    max_r = jnp.asarray(max_range, jnp.float32).reshape(())
+    # real value of one int32 step in the accumulator
+    in_scale = _real_range(min_r, max_r) / float(np.iinfo(np.int32).max)
+    real = data.astype(jnp.float32) * in_scale
+    if min_calib_range is not None and max_calib_range is not None:
+        out_r = max(abs(float(min_calib_range)), abs(float(max_calib_range)))
+        q = jnp.clip(jnp.rint(real * (INT8_MAX / out_r)), -INT8_MAX, INT8_MAX)
+        return (q.astype(jnp.int8), jnp.float32(-out_r), jnp.float32(out_r))
+    out_r = jnp.maximum(jnp.max(jnp.abs(real)), jnp.float32(1e-30))
+    q = jnp.clip(jnp.rint(real * (INT8_MAX / out_r)), -INT8_MAX, INT8_MAX)
+    return q.astype(jnp.int8), -out_r, out_r
+
+
+def _int32_range(range_a, range_b):
+    """Output (min, max) of an int8×int8→int32 contraction: one int32 step
+    represents (ra/127)·(rb/127) real units, scaled so the int32 extremes
+    map to ±ra·rb·(2^31-1)/127² (ref: quantization_utils.h
+    QuantizationRangeForMultiplication)."""
+    r = range_a * range_b * (float(np.iinfo(np.int32).max) / (INT8_MAX * INT8_MAX))
+    return -r, r
+
+
+def _q_argnames(params):
+    """Input names for quantized conv/FC: data tensors then range scalars
+    (ref: quantized_conv.cc FListInputNames order data..., min1, max1, ...)."""
+    if params.get("no_bias", True):
+        return ("data", "weight", "min_data", "max_data",
+                "min_weight", "max_weight")
+    return ("data", "weight", "bias", "min_data", "max_data",
+            "min_weight", "max_weight", "min_bias", "max_bias")
+
+
+def _rescale_bias_to_acc(bias, min_b, max_b, acc_max):
+    """Re-express an int8 bias on the int32-accumulator grid: one int32 unit
+    is acc_max/(2^31-1) real units (ref: quantized_conv.cc bias handling)."""
+    rb = _real_range(jnp.asarray(min_b, jnp.float32).reshape(()),
+                     jnp.asarray(max_b, jnp.float32).reshape(()))
+    acc_step = acc_max / float(np.iinfo(np.int32).max)
+    bias_real = bias.astype(jnp.float32) * (rb / INT8_MAX)
+    return jnp.rint(bias_real / acc_step).astype(jnp.int32)
+
+
+@register("_contrib_quantized_conv", num_inputs=None, num_outputs=3,
+          fargnames=_q_argnames, finfer_params=_qconv_param_shapes,
+          differentiable=False)
+def _quantized_conv(*args, kernel=(), stride=(), dilate=(), pad=(),
+                    num_filter=0, num_group=1, no_bias=True, workspace=1024,
+                    cudnn_tune=None, cudnn_off=False, layout=None):
+    """int8 convolution with int32 accumulation (ref: quantized_conv.cc).
+
+    The conv itself is the float Convolution fcompute handed int8 operands —
+    XLA lowers an s8×s8→s32 conv straight onto the MXU int8 pipeline, the
+    TPU-native replacement for the reference's cuDNN int8 path.
+    """
+    if no_bias:
+        data, weight, min_d, max_d, min_w, max_w = args
+        bias = None
+    else:
+        data, weight, bias, min_d, max_d, min_w, max_w, min_b, max_b = args
+    nd_ = len(kernel) if kernel else data.ndim - 2
+    stride = tuple(stride) if stride else (1,) * nd_
+    dilate = tuple(dilate) if dilate else (1,) * nd_
+    pad = tuple(pad) if pad else (0,) * nd_
+    spatial = "".join("DHW"[3 - nd_ + i] for i in range(nd_))
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)   # s8×s8→s32 on the MXU
+    rd = _real_range(jnp.asarray(min_d, jnp.float32).reshape(()),
+                     jnp.asarray(max_d, jnp.float32).reshape(()))
+    rw = _real_range(jnp.asarray(min_w, jnp.float32).reshape(()),
+                     jnp.asarray(max_w, jnp.float32).reshape(()))
+    omin, omax = _int32_range(rd, rw)
+    if bias is not None:
+        bias32 = _rescale_bias_to_acc(bias, min_b, max_b, omax)
+        out = out + bias32.reshape((1, -1) + (1,) * (out.ndim - 2))
+    return out, jnp.float32(1) * omin, jnp.float32(1) * omax
+
+
+@register("_contrib_quantized_fully_connected", num_inputs=None, num_outputs=3,
+          fargnames=_q_argnames, finfer_params=_qfc_param_shapes,
+          differentiable=False)
+def _quantized_fc(*args, num_hidden=0, no_bias=True, flatten=True):
+    """int8 x·Wᵀ with int32 accumulation (ref: quantized_fully_connected.cc)."""
+    if no_bias:
+        data, weight, min_d, max_d, min_w, max_w = args
+        bias = None
+    else:
+        data, weight, bias, min_d, max_d, min_w, max_w, min_b, max_b = args
+    x = data.reshape((data.shape[0], -1)) if flatten else data
+    out = lax.dot_general(x, weight,
+                          (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    rd = _real_range(jnp.asarray(min_d, jnp.float32).reshape(()),
+                     jnp.asarray(max_d, jnp.float32).reshape(()))
+    rw = _real_range(jnp.asarray(min_w, jnp.float32).reshape(()),
+                     jnp.asarray(max_w, jnp.float32).reshape(()))
+    omin, omax = _int32_range(rd, rw)
+    if bias is not None:
+        out = out + _rescale_bias_to_acc(bias, min_b, max_b, omax)
+    return out, jnp.float32(1) * omin, jnp.float32(1) * omax
+
+
+@register("_contrib_quantized_pooling", num_inputs=3, num_outputs=3,
+          input_names=("data", "min_data", "max_data"),
+          differentiable=False)
+def _quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
+                       global_pool=False, stride=(), pad=(),
+                       pooling_convention="valid", cudnn_off=False, p_value=2,
+                       count_include_pad=True):
+    """Pooling on the int8 grid (ref: quantized_pooling.cc) — max pool is
+    exact in int8; avg pool averages in int32 then rounds back."""
+    min_d = jnp.asarray(min_data, jnp.float32).reshape(())
+    max_d = jnp.asarray(max_data, jnp.float32).reshape(())
+    if pool_type == "max":
+        out = _pooling(data, kernel=kernel, pool_type="max",
+                       global_pool=global_pool, stride=stride, pad=pad,
+                       pooling_convention=pooling_convention)
+    elif pool_type == "avg":
+        s = _pooling(data.astype(jnp.int32), kernel=kernel, pool_type="sum",
+                     global_pool=global_pool, stride=stride, pad=pad,
+                     pooling_convention=pooling_convention)
+        k = data.shape[2:] if global_pool else tuple(kernel)
+        out = jnp.clip(jnp.rint(s / float(np.prod(k))),
+                       -INT8_MAX, INT8_MAX).astype(data.dtype)
+    else:
+        raise ValueError("quantized_pooling supports max/avg, got %r"
+                         % pool_type)
+    return out, min_d, max_d
+
+
+@register("_contrib_quantized_flatten", num_inputs=3, num_outputs=3,
+          input_names=("data", "min_data", "max_data"),
+          differentiable=False)
+def _quantized_flatten(data, min_data, max_data):
+    """ref: quantized_flatten.cc — reshape, range passes through."""
+    return (data.reshape((data.shape[0], -1)),
+            jnp.asarray(min_data, jnp.float32).reshape(()),
+            jnp.asarray(max_data, jnp.float32).reshape(()))
+
+
+# ---------------------------------------------------------------------------
+# Graph-pass metadata: which float ops have a quantized twin, and which
+# quantized ops emit int32 that must be requantized (ref: FQuantizedOp /
+# FNeedRequantize attrs consumed by quantize_graph_pass.cc).
+# ---------------------------------------------------------------------------
+
+QUANTIZED_OP_MAP = {
+    "Convolution": "_contrib_quantized_conv",
+    "FullyConnected": "_contrib_quantized_fully_connected",
+    "Pooling": "_contrib_quantized_pooling",
+    "Flatten": "_contrib_quantized_flatten",
+}
+
+NEED_REQUANTIZE = {"_contrib_quantized_conv",
+                   "_contrib_quantized_fully_connected"}
+
+# float-op params that the quantized twin does not accept
+_DROP_PARAMS = {"Flatten": ("axis",)}
+
+
+def quantizable(op_name, params):
+    """Whether this node can be replaced by its int8 twin under ``params``
+    (Pooling only for max/avg, matching quantized_pooling.cc)."""
+    if op_name not in QUANTIZED_OP_MAP:
+        return False
+    if op_name == "Pooling" and params.get("pool_type", "max") not in ("max", "avg"):
+        return False
+    return True

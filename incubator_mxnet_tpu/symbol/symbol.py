@@ -320,7 +320,7 @@ class Symbol(object):
                     known[name] = shape
         else:
             known.update({k: v for k, v in kwargs.items() if v is not None})
-        shapes, ok = self._propagate_shapes(known, partial)
+        shapes, _, ok = self._propagate_shapes(known, partial)
         if not ok and not partial:
             return (None, None, None)
         arg_shapes = [shapes.get(n) for n in arg_names]
@@ -334,9 +334,11 @@ class Symbol(object):
                 out_shapes.append(shapes.get(_out_key(b, r._out_index or 0)))
         return (arg_shapes, out_shapes, aux_shapes)
 
-    def _propagate_shapes(self, known, partial):
-        """Forward shape propagation via op.infer (jax.eval_shape)."""
+    def _propagate_shapes(self, known, partial, known_dtypes=None):
+        """Forward shape+dtype propagation via op.infer (jax.eval_shape) —
+        FInferShape and FInferType in one pass, so the two can't disagree."""
         shapes = dict(known)
+        dtypes = dict(known_dtypes or {})
         ok = True
         topo = self._topo()
         for node in topo:
@@ -345,6 +347,10 @@ class Symbol(object):
                     declared = node._attr.get("__shape__")
                     if declared and 0 not in declared:
                         shapes[node.name] = tuple(declared)
+                if dtypes.get(node.name) is None:
+                    declared = node._attr.get("__dtype__")
+                    if declared:
+                        dtypes[node.name] = np.dtype(declared)
                 continue
             in_keys = []
             for i in node._inputs:
@@ -372,7 +378,8 @@ class Symbol(object):
                 if any(k not in shapes for k in in_keys):
                     ok = False
                     continue
-            in_shapes = [(tuple(shapes[k]), np.float32) for k in in_keys]
+            in_shapes = [(tuple(shapes[k]), dtypes.get(k, np.float32))
+                         for k in in_keys]
             try:
                 outs = node._op.infer(in_shapes, node._params)
             except Exception as e:
@@ -382,6 +389,7 @@ class Symbol(object):
                 raise MXNetError("Error in operator %s: %s" % (node._name, e))
             for i, (shape, dtype) in enumerate(outs):
                 shapes[_out_key(node, i)] = shape
+                dtypes[_out_key(node, i)] = dtype
         # complete iff every variable got a shape (consumers may have
         # back-filled them after their visit) and every root resolved
         for node in topo:
@@ -392,22 +400,44 @@ class Symbol(object):
             key = b.name if b.is_variable() else _out_key(b, r._out_index or 0)
             if shapes.get(key) is None:
                 ok = False
-        return shapes, ok
+        return shapes, dtypes, ok
 
     def infer_type(self, *args, **kwargs):
-        """ref: symbol.py infer_type — single-dtype propagation."""
+        """ref: symbol.py infer_type.  Real propagation when argument
+        shapes are declared (``__shape__`` attrs); otherwise every slot
+        takes the seed dtype (the reference's common single-dtype case)."""
         arg_names = self.list_arguments()
-        dtype = np.float32
+        aux_names = self.list_auxiliary_states()
+        known_dtypes = {}
         if args:
-            for a in args:
-                if a is not None:
-                    dtype = np.dtype(a)
-                    break
-        elif kwargs:
-            dtype = np.dtype(list(kwargs.values())[0])
-        arg_types = [dtype for _ in arg_names]
-        out_types = [dtype for _ in self._roots()]
-        aux_types = [dtype for _ in self.list_auxiliary_states()]
+            known_dtypes = {n: np.dtype(a) for n, a in zip(arg_names, args)
+                            if a is not None}
+        else:
+            known_dtypes = {k: np.dtype(v) for k, v in kwargs.items()
+                            if v is not None}
+        fallback = next(iter(known_dtypes.values()), np.dtype(np.float32))
+        # seed every undeclared argument with the fallback so the traced
+        # dtypes and the reported arg_types cannot disagree (the reference's
+        # uniform-seed FInferType semantics); vars with a __dtype__ attr
+        # (e.g. int8 quantized params) keep their declaration
+        declared = {n.name for n in self._free_variables()
+                    if n._attr.get("__dtype__")}
+        for n in arg_names:
+            if n not in declared:
+                known_dtypes.setdefault(n, fallback)
+        shapes, dtypes, ok = self._propagate_shapes({}, True, known_dtypes)
+        if not ok:
+            # shapes unknown → cannot trace; uniform seed dtype
+            return ([fallback] * len(arg_names),
+                    [fallback] * len(self._roots()),
+                    [fallback] * len(aux_names))
+        arg_types = [np.dtype(dtypes.get(n, fallback)) for n in arg_names]
+        aux_types = [np.dtype(dtypes.get(n, fallback)) for n in aux_names]
+        out_types = []
+        for r in self._roots():
+            b = r._base()
+            key = b.name if b.is_variable() else _out_key(b, r._out_index or 0)
+            out_types.append(np.dtype(dtypes.get(key, fallback)))
         return (arg_types, out_types, aux_types)
 
     # -- serialization -----------------------------------------------------
@@ -484,29 +514,42 @@ class Symbol(object):
         GraphExecutor::Init, graph_executor.cc:512)."""
         from .executor import Executor
         from .. import ndarray as nd
-        arg_shapes, out_shapes, aux_shapes = self.infer_shape(**kwargs)
-        if arg_shapes is None:
-            raise ValueError("cannot infer shapes for all arguments")
         arg_names = self.list_arguments()
         aux_names = self.list_auxiliary_states()
+        # one propagation pass yields both shapes and dtypes (quantized
+        # graphs carry int8/int32 slots)
+        known_dtypes = {k: np.dtype(v) for k, v in (type_dict or {}).items()}
+        shapes, dtypes, ok = self._propagate_shapes(
+            {k: tuple(v) for k, v in kwargs.items()}, False, known_dtypes)
+        if not ok:
+            raise ValueError("cannot infer shapes for all arguments")
+        arg_shapes = [shapes[n] for n in arg_names]
+        aux_shapes = [shapes[n] for n in aux_names]
+
+        def _reusable(arr, shape, dtype):
+            return (tuple(arr.shape) == tuple(shape)
+                    and np.dtype(arr.dtype) == np.dtype(dtype))
+
         shared = shared_buffer if shared_buffer is not None else {}
         args = {}
         for name, shape in zip(arg_names, arg_shapes):
+            dt = dtypes.get(name, np.float32)
             if shared_exec is not None and name in shared_exec.arg_dict and \
-                    tuple(shared_exec.arg_dict[name].shape) == tuple(shape):
+                    _reusable(shared_exec.arg_dict[name], shape, dt):
                 args[name] = shared_exec.arg_dict[name]
-            elif name in shared and tuple(shared[name].shape) == tuple(shape):
+            elif name in shared and _reusable(shared[name], shape, dt):
                 args[name] = shared[name]
             else:
-                args[name] = nd.zeros(shape, ctx=ctx)
+                args[name] = nd.zeros(shape, ctx=ctx, dtype=dt)
                 shared[name] = args[name]
         aux = {}
         for name, shape in zip(aux_names, aux_shapes):
+            dt = dtypes.get(name, np.float32)
             if shared_exec is not None and name in shared_exec.aux_dict and \
-                    tuple(shared_exec.aux_dict[name].shape) == tuple(shape):
+                    _reusable(shared_exec.aux_dict[name], shape, dt):
                 aux[name] = shared_exec.aux_dict[name]
             else:
-                aux[name] = nd.zeros(shape, ctx=ctx)
+                aux[name] = nd.zeros(shape, ctx=ctx, dtype=dt)
         if isinstance(grad_req, str):
             req_of = {n: grad_req for n in arg_names}
         else:
