@@ -54,3 +54,16 @@ from . import model
 from . import gluon
 from . import parallel
 from . import contrib
+from . import profiler
+from . import config
+from . import visualization
+from . import visualization as viz
+
+# env-var driven startup behavior (SURVEY §5.6 config layer)
+if config.get_bool("PROFILER_AUTOSTART"):
+    import atexit as _atexit
+    profiler.set_config(continuous_dump=True)
+    profiler.set_state("run")
+    _atexit.register(lambda: profiler.set_state("stop"))
+if config.get_int("SEED") is not None:
+    random.seed(config.get_int("SEED"))

@@ -236,15 +236,18 @@ def _internal_outputs_executor(sym, data_iter, ctx, arg_params, aux_params,
     return exe, [names[i] for i in keep]
 
 
-def _iter_calib_batches(exe, data_iter, max_num_examples):
+def _iter_calib_batches(exe, data_iter, max_num_examples,
+                        data_names=None, label_names=None):
     num_examples = 0
     data_iter.reset()
     for batch in data_iter:
         feed = {}
-        for (name, _), arr in zip(data_iter.provide_data, batch.data):
+        dnames = data_names or [n for n, _ in data_iter.provide_data]
+        lnames = label_names or [n for n, _ in data_iter.provide_label]
+        for name, arr in zip(dnames, batch.data):
             if name in exe.arg_dict:
                 feed[name] = arr
-        for (name, _), arr in zip(data_iter.provide_label, batch.label or []):
+        for name, arr in zip(lnames, batch.label or []):
             if name in exe.arg_dict:
                 feed[name] = arr
         exe.forward(is_train=False, **feed)
@@ -255,13 +258,15 @@ def _iter_calib_batches(exe, data_iter, max_num_examples):
 
 
 def collect_layer_output_min_max(sym, data_iter, ctx, arg_params, aux_params,
-                                 include_layer=None, max_num_examples=None):
+                                 include_layer=None, max_num_examples=None,
+                                 data_names=None, label_names=None):
     """Min/max of every layer output over the calibration set
     (ref: _collect_layer_output_min_max)."""
     exe, names = _internal_outputs_executor(sym, data_iter, ctx, arg_params,
                                             aux_params, include_layer)
     th = {}
-    for outputs in _iter_calib_batches(exe, data_iter, max_num_examples):
+    for outputs in _iter_calib_batches(exe, data_iter, max_num_examples,
+                                       data_names, label_names):
         for name, out in zip(names, outputs):
             lo = float(ndarray.min(out).asscalar())
             hi = float(ndarray.max(out).asscalar())
@@ -273,13 +278,15 @@ def collect_layer_output_min_max(sym, data_iter, ctx, arg_params, aux_params,
 
 
 def collect_layer_outputs(sym, data_iter, ctx, arg_params, aux_params,
-                          include_layer=None, max_num_examples=None):
+                          include_layer=None, max_num_examples=None,
+                          data_names=None, label_names=None):
     """Raw layer outputs for entropy calibration
     (ref: _collect_layer_outputs)."""
     exe, names = _internal_outputs_executor(sym, data_iter, ctx, arg_params,
                                             aux_params, include_layer)
     nd_dict = {n: [] for n in names}
-    for outputs in _iter_calib_batches(exe, data_iter, max_num_examples):
+    for outputs in _iter_calib_batches(exe, data_iter, max_num_examples,
+                                       data_names, label_names):
         for name, out in zip(names, outputs):
             nd_dict[name].append(out.asnumpy())
     return nd_dict
@@ -418,13 +425,15 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
             nd_dict = collect_layer_outputs(
                 sym, calib_data, ctx, arg_params, aux_params,
                 include_layer=calib_layer,
-                max_num_examples=num_calib_examples)
+                max_num_examples=num_calib_examples,
+                data_names=list(data_names), label_names=list(label_names))
             th_dict = get_optimal_thresholds(nd_dict, logger=logger)
         elif calib_mode == "naive":
             th_dict = collect_layer_output_min_max(
                 sym, calib_data, ctx, arg_params, aux_params,
                 include_layer=calib_layer,
-                max_num_examples=num_calib_examples)
+                max_num_examples=num_calib_examples,
+                data_names=list(data_names), label_names=list(label_names))
         else:
             raise ValueError("unknown calib_mode %s (expected none, naive or "
                              "entropy)" % calib_mode)

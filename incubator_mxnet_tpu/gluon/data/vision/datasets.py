@@ -14,7 +14,13 @@ import struct
 import numpy as np
 
 from .... import ndarray as _nd
+from .... import config as _config
 from ..dataset import Dataset, RecordFileDataset
+
+
+def _default_root(name):
+    """Dataset cache dir under MXTPU_HOME (default ~/.mxnet/datasets)."""
+    return os.path.join(_config.data_home(), "datasets", name)
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
            "ImageRecordDataset", "ImageFolderDataset"]
@@ -54,10 +60,9 @@ class MNIST(_DownloadedDataset):
     _test_data = "t10k-images-idx3-ubyte.gz"
     _test_label = "t10k-labels-idx1-ubyte.gz"
 
-    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
-                 train=True, transform=None):
+    def __init__(self, root=None, train=True, transform=None):
         self._train = train
-        super().__init__(root, transform)
+        super().__init__(root or _default_root("mnist"), transform)
 
     def _get_data(self):
         if self._train:
@@ -92,10 +97,9 @@ class MNIST(_DownloadedDataset):
 class FashionMNIST(MNIST):
     """ref: datasets.py class FashionMNIST (same idx format)."""
 
-    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
-                                         "fashion-mnist"),
-                 train=True, transform=None):
-        super().__init__(root=root, train=train, transform=transform)
+    def __init__(self, root=None, train=True, transform=None):
+        super().__init__(root or _default_root("fashion-mnist"),
+                         train=train, transform=transform)
 
 
 class CIFAR10(_DownloadedDataset):
@@ -107,10 +111,9 @@ class CIFAR10(_DownloadedDataset):
     _test_member = "test_batch.bin"
     _rec_size = 3073
 
-    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
-                 train=True, transform=None):
+    def __init__(self, root=None, train=True, transform=None):
         self._train = train
-        super().__init__(root, transform)
+        super().__init__(root or _default_root("cifar10"), transform)
 
     def _read_batch(self, filename):
         with open(filename, "rb") as fin:
@@ -143,12 +146,13 @@ class CIFAR100(CIFAR10):
 
     _rec_size = 3074
 
-    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar100"),
-                 fine_label=False, train=True, transform=None):
+    def __init__(self, root=None, fine_label=False, train=True,
+                 transform=None):
         self._fine_label = fine_label
         self._archive_members = ["train.bin"]
         self._test_member = "test.bin"
-        super().__init__(root=root, train=train, transform=transform)
+        super().__init__(root=root or _default_root("cifar100"),
+                         train=train, transform=transform)
 
     def _read_batch(self, filename):
         with open(filename, "rb") as fin:

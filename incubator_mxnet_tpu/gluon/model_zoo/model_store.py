@@ -1,31 +1,40 @@
 """Pretrained model store (ref: python/mxnet/gluon/model_zoo/model_store.py).
 
 This environment has no network egress: pretrained weights resolve only from
-the local root (default ~/.mxnet/models). The API shape (get_model_file,
-purge) matches the reference.
+the local root (default ``$MXTPU_HOME/models``, i.e. ~/.mxnet/models). The
+API shape (get_model_file, purge) matches the reference; MXTPU_GLUON_REPO /
+MXNET_GLUON_REPO is honored for the download URL it would have used.
 """
 from __future__ import annotations
 
 import os
 
+from ... import config as _config
+
 __all__ = ["get_model_file", "purge"]
 
 
-def get_model_file(name, root=os.path.join("~", ".mxnet", "models")):
+def _default_root():
+    return os.path.join(_config.data_home(), "models")
+
+
+def get_model_file(name, root=None):
     """Locate a pretrained parameter file locally (ref: model_store.py
     get_model_file; download path requires egress, absent here)."""
-    root = os.path.expanduser(root or os.path.join("~", ".mxnet", "models"))
+    root = os.path.expanduser(root or _default_root())
     file_path = os.path.join(root, name + ".params")
     if os.path.exists(file_path):
         return file_path
+    repo = _config.get("GLUON_REPO")
     raise IOError(
         "Pretrained model file %s is not present and this environment has no "
-        "network egress. Place the .params file there manually." % file_path)
+        "network egress (would fetch from %s). Place the .params file there "
+        "manually." % (file_path, repo))
 
 
-def purge(root=os.path.join("~", ".mxnet", "models")):
+def purge(root=None):
     """ref: model_store.py purge."""
-    root = os.path.expanduser(root)
+    root = os.path.expanduser(root or _default_root())
     if not os.path.isdir(root):
         return
     for f in os.listdir(root):

@@ -26,6 +26,12 @@ from ..base import MXNetError
 from ..context import Context, current_context, cpu
 from ..ops.registry import get_op, Operator
 from .. import random_state
+from .. import config as _config
+
+# MXTPU_ENGINE_TYPE=NaiveEngine → block after every dispatch (the
+# reference's synchronous debug engine, src/engine/naive_engine.cc);
+# read once at import like dmlc::GetEnv's static locals.
+_NAIVE_ENGINE = _config.naive_engine()
 
 __all__ = ["NDArray", "array", "empty", "invoke", "waitall",
            "concatenate", "moveaxis", "imperative_invoke"]
@@ -522,6 +528,10 @@ def invoke(op: Operator, inputs, params, out=None):
     if op.needs_rng:
         kw["rng"] = random_state.next_key()
 
+    from .. import profiler as _profiler
+    _span = _profiler.op_span(op.name, "imperative")
+    if _span is not None:
+        _span.__enter__()
     if recording:
         fn = op.bind(params, is_train)
         if kw:
@@ -534,6 +544,12 @@ def invoke(op: Operator, inputs, params, out=None):
         fn = op.bind(params, is_train)
         out_vals = fn(*vals, **kw)
         vjp_fn = None
+    if _span is not None:
+        if _profiler.want_sync():
+            jax.block_until_ready(out_vals)
+        _span.__exit__()
+    if _NAIVE_ENGINE:
+        jax.block_until_ready(out_vals)
 
     if not isinstance(out_vals, tuple):
         out_vals = (out_vals,)

@@ -245,8 +245,16 @@ def _quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
         s = _pooling(data.astype(jnp.int32), kernel=kernel, pool_type="sum",
                      global_pool=global_pool, stride=stride, pad=pad,
                      pooling_convention=pooling_convention)
-        k = data.shape[2:] if global_pool else tuple(kernel)
-        out = jnp.clip(jnp.rint(s / float(np.prod(k))),
+        if count_include_pad:
+            k = data.shape[2:] if global_pool else tuple(kernel)
+            cnt = float(np.prod(k))
+        else:
+            # per-window element count, matching the float op's borders
+            cnt = _pooling(jnp.ones(data.shape, jnp.int32), kernel=kernel,
+                           pool_type="sum", global_pool=global_pool,
+                           stride=stride, pad=pad,
+                           pooling_convention=pooling_convention)
+        out = jnp.clip(jnp.rint(s / cnt),
                        -INT8_MAX, INT8_MAX).astype(data.dtype)
     else:
         raise ValueError("quantized_pooling supports max/avg, got %r"

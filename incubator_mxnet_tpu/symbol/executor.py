@@ -125,7 +125,16 @@ class Executor(object):
         arg_vals = {n: self.arg_dict[n]._read() for n in self._arg_names}
         aux_vals = {n: self.aux_dict[n]._read() for n in self._aux_names}
         rng = random_state.next_key()
-        out_vals, aux_out = entry["jit"](arg_vals, aux_vals, rng)
+        from .. import profiler as _profiler
+        _span = _profiler.op_span("Executor.forward(%s)"
+                                  % (self._symbol.name or "sym"), "symbolic")
+        if _span is not None:
+            with _span:
+                out_vals, aux_out = entry["jit"](arg_vals, aux_vals, rng)
+                if _profiler.want_sync():
+                    jax.block_until_ready(out_vals)
+        else:
+            out_vals, aux_out = entry["jit"](arg_vals, aux_vals, rng)
         for n, v in aux_out.items():
             self.aux_dict[n]._write(v)
         self.outputs = [NDArray(v, ctx=self._ctx) for v in out_vals]

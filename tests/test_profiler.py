@@ -1,0 +1,101 @@
+"""Profiler tests (parity model: tests/python/unittest/test_profiler.py)."""
+import json
+import os
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, profiler
+
+
+def test_profile_operators(tmp_path):
+    fname = str(tmp_path / "profile_op.json")
+    profiler.set_config(filename=fname, profile_imperative=True)
+    profiler.set_state("run")
+    a = nd.array(np.random.randn(32, 32).astype(np.float32))
+    b = nd.array(np.random.randn(32, 32).astype(np.float32))
+    for _ in range(3):
+        c = nd.dot(a, b)
+    c.asnumpy()
+    profiler.set_state("stop")
+    path = profiler.dump()
+    assert path == fname and os.path.exists(fname)
+    with open(fname) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    dots = [e for e in events if e["name"] == "dot" and e["ph"] == "X"]
+    assert len(dots) >= 3
+    assert all(e["dur"] >= 0 and "ts" in e for e in dots)
+
+
+def test_profile_pause_and_aggregate():
+    profiler.set_config(filename="unused.json")
+    profiler.set_state("run")
+    x = nd.ones((8, 8))
+    y = x + x
+    profiler.pause()
+    _ = x * x          # not recorded
+    profiler.resume()
+    z = y * y
+    z.asnumpy()
+    profiler.set_state("stop")
+    table = profiler.dumps(reset=True)
+    assert "Calls" in table and "Avg(us)" in table
+    lines = [ln for ln in table.splitlines() if ln.strip()]
+    assert len(lines) >= 2   # header + at least one op row
+
+
+def test_profile_executor_symbolic(tmp_path):
+    fname = str(tmp_path / "profile_sym.json")
+    profiler.set_config(filename=fname, profile_symbolic=True)
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    exe = fc.simple_bind(ctx=mx.cpu(), data=(2, 8), grad_req="null")
+    profiler.set_state("run")
+    exe.forward(is_train=False, data=nd.ones((2, 8)))
+    exe.outputs[0].asnumpy()
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        events = json.load(f)["traceEvents"]
+    assert any(e["name"].startswith("Executor.forward") for e in events)
+
+
+def test_profile_custom_objects(tmp_path):
+    fname = str(tmp_path / "profile_custom.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    dom = profiler.Domain("app")
+    with dom.new_task("step"):
+        pass
+    with profiler.Event("tick"):
+        pass
+    cnt = dom.new_counter("samples", 0)
+    cnt += 5
+    cnt -= 2
+    dom.new_marker("here").mark("global")
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        events = json.load(f)["traceEvents"]
+    names = {e["name"] for e in events}
+    assert {"step", "tick", "samples", "here"} <= names
+    counter_vals = [e["args"]["samples"] for e in events
+                    if e["name"] == "samples"]
+    assert counter_vals == [0, 5, 3]
+    marker = [e for e in events if e["name"] == "here"][0]
+    assert marker["ph"] == "i" and marker["s"] == "g"
+
+
+def test_profiler_sync_mode(tmp_path):
+    fname = str(tmp_path / "profile_sync.json")
+    profiler.set_config(filename=fname, sync=True)
+    profiler.set_state("run")
+    a = nd.ones((64, 64))
+    nd.dot(a, a)
+    profiler.set_state("stop")
+    profiler.set_config(sync=False)
+    profiler.dump()
+    with open(fname) as f:
+        events = json.load(f)["traceEvents"]
+    assert any(e["name"] == "dot" for e in events)

@@ -1,0 +1,92 @@
+"""Tests for visualization, env config layer, and the im2rec tool.
+
+Parity models: python/mxnet/visualization.py, docs/faq/env_var.md,
+tools/im2rec.py.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import config, visualization
+
+
+def _mlp():
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return mx.sym.softmax(fc2, name="sm")
+
+
+def test_print_summary(capsys):
+    out = visualization.print_summary(_mlp(), shape={"data": (2, 8)})
+    # params: fc1 = 8*16+16 = 144, fc2 = 16*4+4 = 68 → 212
+    assert "Total params: 212" in out
+    assert "fc1(FullyConnected)" in out
+    assert "relu1(Activation)" in out
+
+
+def test_plot_network_dot():
+    res = visualization.plot_network(_mlp(), title="net")
+    src = res if isinstance(res, str) else res.source
+    assert "digraph" in src
+    assert '"fc1" -> "relu1"' in src and '"relu1" -> "fc2"' in src
+    assert '"data"' in src          # data var shown
+    assert '"fc1_weight"' not in src  # weights hidden by default
+
+
+def test_config_env_layer(monkeypatch):
+    assert config.get("ENGINE_TYPE") == "AsyncEngine"
+    monkeypatch.setenv("MXTPU_ENGINE_TYPE", "NaiveEngine")
+    assert config.naive_engine()
+    monkeypatch.delenv("MXTPU_ENGINE_TYPE")
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "NaiveEngine")  # fallback prefix
+    assert config.naive_engine()
+    monkeypatch.setenv("MXTPU_SEED", "123")
+    assert config.get_int("SEED") == 123
+    monkeypatch.setenv("MXTPU_PROFILER_AUTOSTART", "true")
+    assert config.get_bool("PROFILER_AUTOSTART")
+    doc = config.document()
+    assert "MXTPU_ENGINE_TYPE" in doc and "NaiveEngine" in doc
+    # generated doc is committed
+    here = os.path.join(os.path.dirname(__file__), "..", "docs", "env_var.md")
+    assert os.path.exists(here)
+
+
+def test_im2rec_list_and_pack(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+    root = tmp_path / "images"
+    for cls in ("cat", "dog"):
+        d = root / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            img = (np.random.RandomState(i).rand(8, 8, 3) * 255).astype("uint8")
+            cv2.imwrite(str(d / ("%s_%d.jpg" % (cls, i))), img)
+    prefix = str(tmp_path / "set")
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools", "im2rec.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, tool, prefix, str(root),
+                        "--list", "--recursive"], env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    lines = open(prefix + ".lst").read().strip().splitlines()
+    assert len(lines) == 6
+    labels = {float(ln.split("\t")[1]) for ln in lines}
+    assert labels == {0.0, 1.0}
+
+    r = subprocess.run([sys.executable, tool, prefix, str(root),
+                        "--resize", "8"], env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(prefix + ".rec") and os.path.exists(prefix + ".idx")
+
+    from incubator_mxnet_tpu import recordio
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    keys = sorted(rec.keys)
+    assert len(keys) == 6
+    hdr, img = recordio.unpack_img(rec.read_idx(keys[0]))
+    assert img.shape[2] == 3 and hdr.label in (0.0, 1.0)

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""im2rec: pack an image directory into a RecordIO file.
+
+TPU-native rebirth of the reference's tools/im2rec.py (and the C++
+tools/im2rec.cc): makes .lst index files from a directory tree and packs
+the listed images (optionally resized/re-encoded) into .rec/.idx pairs
+that ImageRecordIter / ImageRecordDataset consume.
+
+Usage (same two-phase flow as the reference):
+    python tools/im2rec.py prefix image_root --list --recursive
+    python tools/im2rec.py prefix image_root --resize 256 --quality 95
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from incubator_mxnet_tpu import recordio  # noqa: E402
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_images(root, recursive):
+    """Yield (relpath, label) with labels assigned per sorted subdirectory
+    (ref: im2rec.py list_image)."""
+    if recursive:
+        cat = {}
+        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+            dirs.sort()
+            for fname in sorted(files):
+                if os.path.splitext(fname)[1].lower() not in _EXTS:
+                    continue
+                label_dir = os.path.relpath(path, root).split(os.sep)[0]
+                if label_dir not in cat:
+                    cat[label_dir] = len(cat)
+                yield (os.path.relpath(os.path.join(path, fname), root),
+                       cat[label_dir])
+    else:
+        k = 0
+        for fname in sorted(os.listdir(root)):
+            if os.path.splitext(fname)[1].lower() in _EXTS:
+                yield fname, 0
+                k += 1
+
+
+def write_list(path_out, image_list):
+    """.lst format: index \\t label \\t relpath (ref: im2rec.py write_list)."""
+    with open(path_out, "w") as f:
+        for i, (path, label) in enumerate(image_list):
+            f.write("%d\t%f\t%s\n" % (i, float(label), path))
+
+
+def read_list(path_in):
+    with open(path_in) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def make_record(prefix, root, args):
+    """Pack every .lst entry into prefix.rec/prefix.idx
+    (ref: im2rec.py image_encode + write_worker)."""
+    try:
+        import cv2
+    except ImportError:
+        raise SystemExit("im2rec packing requires opencv-python (cv2)")
+    lst = prefix + ".lst"
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    for idx, labels, relpath in read_list(lst):
+        fullpath = os.path.join(root, relpath)
+        header = recordio.IRHeader(0, labels[0] if len(labels) == 1
+                                   else labels, idx, 0)
+        if args.pass_through:
+            with open(fullpath, "rb") as f:
+                s = recordio.pack(header, f.read())
+        else:
+            img = cv2.imread(fullpath, cv2.IMREAD_COLOR)
+            if img is None:
+                print("imread failed, skipping %s" % fullpath)
+                continue
+            if args.resize:
+                h, w = img.shape[:2]
+                scale = args.resize / min(h, w)
+                img = cv2.resize(img, (int(w * scale + 0.5),
+                                       int(h * scale + 0.5)))
+            if args.center_crop:
+                h, w = img.shape[:2]
+                m = min(h, w)
+                y0, x0 = (h - m) // 2, (w - m) // 2
+                img = img[y0:y0 + m, x0:x0 + m]
+            s = recordio.pack_img(header, img, quality=args.quality,
+                                  img_fmt=args.encoding)
+        rec.write_idx(idx, s)
+        n += 1
+        if n % 1000 == 0:
+            print("packed %d images" % n)
+    rec.close()
+    print("wrote %d records to %s.rec" % (n, prefix))
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Create image lists and RecordIO packs "
+                    "(ref: tools/im2rec.py)")
+    ap.add_argument("prefix", help="output prefix (prefix.lst/.rec/.idx)")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--list", action="store_true",
+                    help="create the .lst index instead of packing")
+    ap.add_argument("--recursive", action="store_true",
+                    help="label images by first-level subdirectory")
+    ap.add_argument("--shuffle", type=int, default=1)
+    ap.add_argument("--train-ratio", type=float, default=1.0)
+    ap.add_argument("--test-ratio", type=float, default=0.0)
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter edge to this many pixels")
+    ap.add_argument("--center-crop", action="store_true")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
+    ap.add_argument("--pass-through", action="store_true",
+                    help="pack raw files without re-encoding")
+    args = ap.parse_args()
+
+    if args.list:
+        images = list(list_images(args.root, args.recursive))
+        if args.shuffle:
+            random.seed(100)    # fixed seed like the reference
+            random.shuffle(images)
+        n_train = int(len(images) * args.train_ratio)
+        n_test = int(len(images) * args.test_ratio)
+        if args.train_ratio < 1.0 or args.test_ratio > 0.0:
+            write_list(args.prefix + "_train.lst", images[:n_train])
+            if n_test:
+                write_list(args.prefix + "_test.lst",
+                           images[n_train:n_train + n_test])
+            rest = images[n_train + n_test:]
+            if rest:
+                write_list(args.prefix + "_val.lst", rest)
+        else:
+            write_list(args.prefix + ".lst", images)
+        print("listed %d images" % len(images))
+    else:
+        make_record(args.prefix, args.root, args)
+
+
+if __name__ == "__main__":
+    main()
