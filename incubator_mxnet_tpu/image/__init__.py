@@ -1,3 +1,5 @@
 """Image API (ref: python/mxnet/image/__init__.py)."""
 from .image import *
+from .detection import *
+from . import detection
 from . import image

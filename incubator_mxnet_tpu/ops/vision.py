@@ -508,3 +508,231 @@ def _roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
         return vals
 
     return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# Deformable ops + position-sensitive ROI pooling (R-FCN / Deformable
+# ConvNets; ref: src/operator/contrib/deformable_convolution.cc,
+# psroi_pooling.cc, deformable_psroi_pooling.cc — custom CUDA in the
+# reference, vectorized XLA gathers + one MXU matmul here)
+# ---------------------------------------------------------------------------
+
+def _deform_conv_param_shapes(data_shape, params):
+    """offset comes from a sibling conv, so only weight/bias back-fill."""
+    nf = params.get("num_filter", 0)
+    ng = params.get("num_group", 1)
+    kernel = tuple(params.get("kernel", ()))
+    return {"weight": (nf, data_shape[1] // ng) + kernel, "bias": (nf,)}
+
+
+def _deform_argnames(params):
+    if params.get("no_bias", False):
+        return ("data", "offset", "weight")
+    return ("data", "offset", "weight", "bias")
+
+
+@register("_contrib_DeformableConvolution", num_inputs=None,
+          fargnames=_deform_argnames,
+          finfer_params=_deform_conv_param_shapes,
+          aliases=("DeformableConvolution",))
+def _deformable_convolution(*args, kernel=(), stride=(), dilate=(), pad=(),
+                            num_filter=0, num_group=1,
+                            num_deformable_group=1, workspace=1024,
+                            no_bias=False, layout=None):
+    """Deformable convolution v1 (ref: deformable_convolution-inl.h).
+
+    offset has 2·DG·kh·kw channels laid out [dg][tap][y,x] over the output
+    grid.  Implementation: deformable im2col via vectorized bilinear
+    gathers (one per kernel tap — a static python loop of kh·kw), then the
+    contraction runs as a single batched matmul on the MXU — the same
+    im2col+gemm structure as the reference's CUDA path
+    (deformable_im2col.cuh), with XLA owning the gather fusion.
+    """
+    if no_bias:
+        data, offset, weight = args
+        bias = None
+    else:
+        data, offset, weight, bias = args
+    N, C, H, W = data.shape
+    kh, kw = kernel
+    sh, sw = stride if stride else (1, 1)
+    dh, dw = dilate if dilate else (1, 1)
+    ph, pw = pad if pad else (0, 0)
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    DG = num_deformable_group
+    G = num_group
+    base_y = jnp.arange(Ho, dtype=jnp.float32) * sh - ph      # (Ho,)
+    base_x = jnp.arange(Wo, dtype=jnp.float32) * sw - pw      # (Wo,)
+
+    def per_image(img, off):
+        off = off.reshape(DG, kh * kw, 2, Ho, Wo)
+        img_g = img.reshape(DG, C // DG, H, W)
+        taps = []
+        for k in range(kh * kw):
+            i, j = divmod(k, kw)
+            per_dg = []
+            for dg in range(DG):
+                gy = base_y[:, None] + i * dh + off[dg, k, 0]
+                gx = base_x[None, :] + j * dw + off[dg, k, 1]
+                per_dg.append(_bilinear_gather(img_g[dg], gx, gy))
+            taps.append(jnp.concatenate(per_dg, axis=0))  # (C, Ho, Wo)
+        # (C, K, Ho*Wo) im2col buffer
+        col = jnp.stack(taps, axis=1).reshape(C, kh * kw, Ho * Wo)
+        col = col.reshape(G, (C // G) * kh * kw, Ho * Wo)
+        wmat = weight.reshape(G, num_filter // G, (C // G) * kh * kw)
+        out = jnp.einsum("gfk,gkp->gfp", wmat, col,
+                         preferred_element_type=jnp.float32)
+        return out.reshape(num_filter, Ho, Wo)
+
+    out = jax.vmap(per_image)(data, offset)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+@register("_contrib_PSROIPooling", num_inputs=2, nograd_inputs=(1,),
+          aliases=("PSROIPooling",))
+def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=0,
+                   pooled_size=0, group_size=0):
+    """Position-sensitive ROI pooling (ref: psroi_pooling.cu kernel).
+
+    data (N, output_dim·gs², H, W); rois (R, 5); out (R, output_dim,
+    k, k) — bin (ph, pw) averages channel (ctop·gs + gh)·gs + gw over its
+    spatial extent.  Dynamic ROI bounds become masks over the full map
+    (the ROIPooling trick above), keeping shapes static for XLA.
+    """
+    N, Cc, H, W = data.shape
+    k = int(pooled_size)
+    gs = int(group_size) if group_size else k
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    # channel index per (ctop, ph, pw): static table
+    ctop = np.arange(output_dim)[:, None, None]
+    gh = np.minimum(np.maximum((np.arange(k) * gs) // k, 0), gs - 1)
+    chan = jnp.asarray(((ctop * gs + gh[None, :, None]) * gs
+                        + gh[None, None, :]).astype(np.int32))  # (od, k, k)
+
+    def one_roi(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h = rh / k
+        bin_w = rw / k
+        img = data[bi]                                    # (Cc, H, W)
+
+        def pool_bin(co, py, px):
+            hstart = jnp.clip(jnp.floor(py * bin_h + y1), 0, H)
+            hend = jnp.clip(jnp.ceil((py + 1) * bin_h + y1), 0, H)
+            wstart = jnp.clip(jnp.floor(px * bin_w + x1), 0, W)
+            wend = jnp.clip(jnp.ceil((px + 1) * bin_w + x1), 0, W)
+            mask = ((ys[:, None] >= hstart) & (ys[:, None] < hend)
+                    & (xs[None, :] >= wstart) & (xs[None, :] < wend))
+            cnt = mask.sum()
+            # only the bin's position-sensitive channel is reduced
+            s = jnp.where(mask, img[chan[co, py, px]], 0.0).sum()
+            return jnp.where(cnt > 0, s / jnp.maximum(cnt, 1), 0.0)
+
+        cos = jnp.arange(output_dim)
+        bins = jnp.arange(k)
+        return jax.vmap(lambda co: jax.vmap(lambda py: jax.vmap(
+            lambda px: pool_bin(co, py, px))(bins))(bins))(cos)
+
+    return jax.vmap(one_roi)(rois)
+
+
+def _dpsroi_argnames(params):
+    if params.get("no_trans", False):
+        return ("data", "rois")
+    return ("data", "rois", "trans")
+
+
+@register("_contrib_DeformablePSROIPooling", num_inputs=None,
+          num_outputs=2, num_visible_outputs=1,
+          fargnames=_dpsroi_argnames, nograd_inputs=(1,),
+          aliases=("DeformablePSROIPooling",))
+def _deformable_psroi_pooling(*args, spatial_scale=1.0, output_dim=0,
+                              group_size=0, pooled_size=0, part_size=0,
+                              sample_per_part=1, trans_std=0.0,
+                              no_trans=False):
+    """Deformable position-sensitive ROI pooling
+    (ref: deformable_psroi_pooling.cu DeformablePSROIPoolForwardKernel).
+
+    Each bin's sampling window shifts by a learned normalized offset from
+    ``trans`` (shape (R, 2·num_classes, part, part)); sample_per_part²
+    points are bilinearly sampled and averaged.  Outputs (out, top_count)
+    like the reference (top_count feeds its backward pass; here autograd
+    differentiates the sampling directly and top_count is aux).
+    """
+    if no_trans:
+        data, rois = args
+        trans = None
+    else:
+        data, rois, trans = args
+    N, Cc, H, W = data.shape
+    k = int(pooled_size)
+    gs = int(group_size) if group_size else k
+    part = int(part_size) if part_size else k
+    spp = max(int(sample_per_part), 1)
+    num_classes = 1 if no_trans else trans.shape[1] // 2
+    chan_per_class = output_dim // num_classes
+
+    ctop = np.arange(output_dim)[:, None, None]
+    gh = np.minimum(np.maximum((np.arange(k) * gs) // k, 0), gs - 1)
+    chan = jnp.asarray(((ctop * gs + gh[None, :, None]) * gs
+                        + gh[None, None, :]).astype(np.int32))  # (od, k, k)
+    part_of = jnp.asarray(np.floor(np.arange(k) / k * part).astype(np.int32))
+    class_of = jnp.asarray((np.arange(output_dim)
+                            // chan_per_class).astype(np.int32))
+
+    def one_roi(roi, tr):
+        bi = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h, bin_w = rh / k, rw / k
+        sub_h, sub_w = bin_h / spp, bin_w / spp
+        img = data[bi]
+
+        def pool_one(co, py, px):
+            cls = class_of[co]
+            if no_trans:
+                tx = ty = jnp.float32(0.0)
+            else:
+                tx = tr[2 * cls, part_of[py], part_of[px]] * trans_std
+                ty = tr[2 * cls + 1, part_of[py], part_of[px]] * trans_std
+            hstart = py.astype(jnp.float32) * bin_h + y1 + ty * rh
+            wstart = px.astype(jnp.float32) * bin_w + x1 + tx * rw
+            iy = jnp.arange(spp, dtype=jnp.float32)
+            hh = hstart + iy * sub_h                     # (spp,)
+            ww = wstart + iy * sub_w
+            hgrid, wgrid = jnp.meshgrid(hh, ww, indexing="ij")
+            valid = ((wgrid > -0.5) & (wgrid < W - 0.5)
+                     & (hgrid > -0.5) & (hgrid < H - 0.5))
+            hs = jnp.clip(hgrid, 0.0, H - 1.0)
+            wsx = jnp.clip(wgrid, 0.0, W - 1.0)
+            vals = _bilinear_gather(img[chan[co, py, px]][None], wsx, hs)[0]
+            cnt = valid.sum()
+            s = jnp.where(valid, vals, 0.0).sum()
+            return (jnp.where(cnt > 0, s / jnp.maximum(cnt, 1), 0.0),
+                    cnt.astype(jnp.float32))
+
+        cos = jnp.arange(output_dim)
+        bins = jnp.arange(k)
+        return jax.vmap(lambda co: jax.vmap(lambda py: jax.vmap(
+            lambda px: pool_one(co, py, px))(bins))(bins))(cos)
+
+    if trans is None:
+        dummy = jnp.zeros((rois.shape[0], 2, part, part), jnp.float32)
+        out, cnt = jax.vmap(one_roi)(rois, dummy)
+    else:
+        out, cnt = jax.vmap(one_roi)(rois, trans)
+    return out, cnt

@@ -1,0 +1,201 @@
+"""Deformable conv / PSROI pooling / detection pipeline tests.
+
+Parity models: reference tests for contrib ops
+(tests/python/gpu/test_operator_gpu.py test_deformable_convolution,
+test_psroipooling) and python/mxnet/image/detection.py usage in the SSD
+example (SSD-shaped train step = VERDICT #7 Done criterion).
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    """With zero offsets, deformable conv == plain conv."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 8, 8).astype(np.float32)
+    w = rng.randn(6, 4, 3, 3).astype(np.float32)
+    b = rng.randn(6).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 8, 8), np.float32)
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), nd.array(b),
+        kernel=(3, 3), num_filter=6, pad=(1, 1))
+    ref = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(3, 3), num_filter=6, pad=(1, 1))
+    assert_almost_equal(out.asnumpy(), ref.asnumpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_offset_shifts():
+    """Integer offset (0, 1) samples one pixel right — equals conv on the
+    shifted image (interior pixels)."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    w = rng.randn(3, 2, 1, 1).astype(np.float32)
+    off = np.zeros((1, 2, 6, 6), np.float32)
+    off[:, 1] = 1.0   # x-offset +1 for the single 1x1 tap
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w),
+        kernel=(1, 1), num_filter=3, no_bias=True)
+    shifted = np.zeros_like(x)
+    shifted[..., :-1] = x[..., 1:]
+    ref = nd.Convolution(nd.array(shifted), nd.array(w), kernel=(1, 1),
+                         num_filter=3, no_bias=True)
+    assert_almost_equal(out.asnumpy()[..., :-1], ref.asnumpy()[..., :-1],
+                        rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_trainable():
+    """Gradients flow to data, offset and weight."""
+    rng = np.random.RandomState(2)
+    x = nd.array(rng.randn(1, 2, 5, 5).astype(np.float32))
+    # k=2, pad=1 → output 6x6; offset carries 2·kh·kw channels over it
+    off = nd.array(rng.randn(1, 2 * 4, 6, 6).astype(np.float32) * 0.1)
+    w = nd.array(rng.randn(4, 2, 2, 2).astype(np.float32))
+    for a in (x, off, w):
+        a.attach_grad()
+    with autograd.record():
+        y = nd.contrib.DeformableConvolution(
+            x, off, w, kernel=(2, 2), num_filter=4, pad=(1, 1),
+            no_bias=True)
+        loss = nd.sum(y * y)
+    loss.backward()
+    for a in (x, off, w):
+        assert float(nd.norm(a.grad).asscalar()) > 0
+
+
+def test_psroi_pooling():
+    """Constant-per-channel data: each output bin returns its
+    position-sensitive channel's value."""
+    od, k = 2, 3
+    C = od * k * k
+    data = np.zeros((1, C, 12, 12), np.float32)
+    for c in range(C):
+        data[0, c] = c
+    rois = np.array([[0, 0, 0, 11, 11]], np.float32)
+    out = nd.contrib.PSROIPooling(nd.array(data), nd.array(rois),
+                                  spatial_scale=1.0, output_dim=od,
+                                  pooled_size=k)
+    assert out.shape == (1, od, k, k)
+    got = out.asnumpy()[0]
+    for ct in range(od):
+        for ph in range(k):
+            for pw in range(k):
+                expect = (ct * k + ph) * k + pw
+                assert got[ct, ph, pw] == expect, (ct, ph, pw)
+
+
+def test_deformable_psroi_pooling():
+    od, k = 2, 2
+    C = od * k * k
+    rng = np.random.RandomState(3)
+    data = rng.randn(1, C, 10, 10).astype(np.float32)
+    rois = np.array([[0, 1, 1, 8, 8]], np.float32)
+    trans = np.zeros((1, 2, k, k), np.float32)
+    out = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), nd.array(trans),
+        spatial_scale=1.0, output_dim=od, pooled_size=k, group_size=k,
+        part_size=k, sample_per_part=2, trans_std=0.1)
+    assert out.shape == (1, od, k, k)
+    # no_trans variant matches zero-trans
+    out2 = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois),
+        spatial_scale=1.0, output_dim=od, pooled_size=k, group_size=k,
+        part_size=k, sample_per_part=2, trans_std=0.1, no_trans=True)
+    assert_almost_equal(out.asnumpy(), out2.asnumpy(), rtol=1e-5)
+
+
+def _make_det_samples(tmp_path, n=6, size=32):
+    cv2 = pytest.importorskip("cv2")
+    import incubator_mxnet_tpu.recordio as recordio
+    prefix = str(tmp_path / "det")
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = (rng.rand(size, size, 3) * 255).astype(np.uint8)
+        # label: header [hw=2, ow=5], one object per image
+        cls = float(i % 3)
+        box = np.array([cls, 0.1, 0.2, 0.6, 0.7], np.float32)
+        label = np.concatenate([[2, 5], box]).astype(np.float32)
+        header = recordio.IRHeader(0, label, i, 0)
+        rec.write_idx(i, recordio.pack_img(header, img, quality=90))
+    rec.close()
+    return prefix
+
+
+def test_image_det_iter(tmp_path):
+    prefix = _make_det_samples(tmp_path)
+    it = mx.image.ImageDetIter(batch_size=3, data_shape=(3, 16, 16),
+                               path_imgrec=prefix + ".rec",
+                               path_imgidx=prefix + ".idx")
+    assert it.provide_label[0][1] == (3, 1, 5)
+    batch = next(iter([it.next()]))
+    assert batch.data[0].shape == (3, 3, 16, 16)
+    lab = batch.label[0].asnumpy()
+    assert lab.shape == (3, 1, 5)
+    assert (lab[:, 0, 0] >= 0).all()          # class ids present
+    assert (lab[:, 0, 3] > lab[:, 0, 1]).all()  # valid boxes
+
+
+def test_det_augmenters_preserve_box_validity(tmp_path):
+    prefix = _make_det_samples(tmp_path)
+    it = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                               path_imgrec=prefix + ".rec",
+                               path_imgidx=prefix + ".idx",
+                               rand_crop=0.8, rand_pad=0.8,
+                               rand_mirror=True,
+                               min_object_covered=0.5)
+    for _ in range(3):
+        it.reset()
+        batch = it.next()
+        lab = batch.label[0].asnumpy()
+        valid = lab[lab[:, :, 0] >= 0]
+        assert valid.size > 0
+        assert (valid[:, 1:5] >= -1e-6).all() and (valid[:, 1:5] <= 1 + 1e-6).all()
+        assert (valid[:, 3] > valid[:, 1]).all()
+
+
+def test_ssd_shaped_train_step():
+    """SSD-shaped forward+backward: conv features → MultiBoxPrior/Target →
+    losses → gradients (VERDICT #7 Done criterion)."""
+    rng = np.random.RandomState(4)
+    B, nA = 2, 4
+    x = nd.array(rng.randn(B, 3, 32, 32).astype(np.float32))
+    w = nd.array((rng.randn(8, 3, 3, 3) * 0.1).astype(np.float32))
+    wc = nd.array((rng.randn(nA * 4, 8, 3, 3) * 0.1).astype(np.float32))
+    wl = nd.array((rng.randn(nA * 4, 8, 3, 3) * 0.1).astype(np.float32))
+    labels = np.full((B, 2, 5), -1, np.float32)
+    labels[:, 0] = [0, 0.1, 0.1, 0.5, 0.5]
+    labels_nd = nd.array(labels)
+    for a in (w, wc, wl):
+        a.attach_grad()
+    with autograd.record():
+        feat = nd.Convolution(x, w, kernel=(3, 3), num_filter=8,
+                              pad=(1, 1), stride=(2, 2), no_bias=True)
+        anchors = nd.contrib.MultiBoxPrior(feat, sizes=(0.3, 0.6),
+                                           ratios=(1.0, 2.0, 0.5))
+        cls_pred = nd.Convolution(feat, wc, kernel=(3, 3),
+                                  num_filter=nA * 4, pad=(1, 1),
+                                  no_bias=True)
+        cls_pred = nd.reshape(nd.transpose(cls_pred, axes=(0, 2, 3, 1)),
+                              shape=(B, -1, 4))
+        cls_pred = nd.transpose(cls_pred, axes=(0, 2, 1))
+        loc_pred = nd.Convolution(feat, wl, kernel=(3, 3),
+                                  num_filter=nA * 4, pad=(1, 1),
+                                  no_bias=True)
+        loc_pred = nd.reshape(nd.transpose(loc_pred, axes=(0, 2, 3, 1)),
+                              shape=(B, -1))
+        loc_target, loc_mask, cls_target = nd.contrib.MultiBoxTarget(
+            anchors, labels_nd, cls_pred)
+        loc_loss = nd.sum(nd.abs(loc_pred * loc_mask - loc_target))
+        flat_pred = nd.reshape(nd.transpose(cls_pred, axes=(0, 2, 1)),
+                               shape=(-1, 4))
+        flat_target = nd.reshape(cls_target, shape=(-1,))
+        cls_prob = nd.SoftmaxOutput(flat_pred, flat_target,
+                                    normalization="valid")
+        cls_loss = nd.sum(cls_prob)
+        loss = loc_loss + cls_loss
+    loss.backward()
+    assert float(nd.norm(wl.grad).asscalar()) > 0
