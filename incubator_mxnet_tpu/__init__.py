@@ -54,6 +54,8 @@ from . import model
 from . import gluon
 from . import parallel
 from . import contrib
+from . import operator
+from . import rnn
 from . import profiler
 from . import config
 from . import visualization

@@ -91,6 +91,32 @@ def onehot_encode(indices, out):
 _register.populate(globals())
 _register.module_surface = sys.modules[__name__]
 
+
+def Custom(*args, **kwargs):
+    """Python-defined custom op (ref: src/operator/custom/custom.cc;
+    register via mx.operator.register)."""
+    from ..operator import custom_nd
+    return custom_nd(*args, **kwargs)
+
+
+def cast_storage(arr, stype="default"):
+    """Storage-type cast honoring sparse stypes on the eager surface
+    (ref: src/operator/tensor/cast_storage.cc).  Shadows the registry's
+    dense pass-through (which serves compiled Symbol graphs where every
+    tensor is dense)."""
+    from . import sparse as _sparse
+    return _sparse.cast_storage(arr, stype)
+
+
+def sparse_retain(data, indices):
+    """Row retention preserving row_sparse storage on the eager surface
+    (ref: src/operator/tensor/sparse_retain.cc)."""
+    from . import sparse as _sparse
+    from .sparse import RowSparseNDArray
+    if isinstance(data, RowSparseNDArray):
+        return _sparse.retain(data, indices)
+    return invoke(get_op("_sparse_retain_dense"), [data, indices], {})
+
 # expose submodule-style accessors for parity: nd.random, nd.linalg
 from . import random  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401

@@ -1,0 +1,222 @@
+"""Custom operators written in Python, usable from NDArray AND Symbol.
+
+TPU-native rebirth of python/mxnet/operator.py (CustomOp:422,
+CustomOpProp:468, register:~600) + src/operator/custom/custom-inl.h:50-134
+(the C++ CustomOperator registry with its GIL-safe callback queue).
+
+Design: the reference marshals Python callbacks through the engine's
+worker threads; here each registered custom op becomes a real registry
+Operator whose fcompute escapes to the host via ``jax.pure_callback`` —
+so custom Python ops work in eager mode, inside ``jax.jit``, and inside
+compiled Symbol executors alike.  Gradients route through
+``jax.custom_vjp`` to the user's ``backward`` (also a host callback).
+
+The (unavoidable) cost is a device→host→device round trip per call, the
+same penalty the reference pays for leaving the engine; everything
+around the custom node stays fused on the TPU.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ops.registry import Operator, _REGISTRY
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered_operators"]
+
+_CUSTOM_PROPS = {}
+
+
+class CustomOp(object):
+    """Base class for the runtime part of a custom operator
+    (ref: operator.py CustomOp:422)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        """Compute outputs: write into ``out_data`` via :meth:`assign`."""
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        """Compute input gradients into ``in_grad`` via :meth:`assign`."""
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """ref: operator.py CustomOp.assign — honors req null/write/add."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise ValueError("unknown req %r" % req)
+
+
+class CustomOpProp(object):
+    """Static properties of a custom operator (ref: CustomOpProp:468)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        """Default: all outputs/aux take the first input's shape."""
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), \
+            [in_shape[0]] * len(self.list_auxiliary_states())
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), \
+            [in_type[0]] * len(self.list_auxiliary_states())
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def _as_ndarrays(np_arrays):
+    from .ndarray import NDArray
+    return [NDArray(jnp.asarray(a)) for a in np_arrays]
+
+
+def _make_custom_operator(op_type, prop_cls):
+    """Build a registry Operator for one registered CustomOpProp."""
+
+    def make_prop(params):
+        kwargs = {k: str(v) for k, v in params.items()
+                  if k not in ("op_type",)}
+        return prop_cls(**kwargs)
+
+    sample = make_prop({})
+    n_in = len(sample.list_arguments())
+    n_out = len(sample.list_outputs())
+    input_names = tuple(sample.list_arguments())
+
+    def fcompute(*inputs, **params):
+        prop = make_prop(params)
+        in_shapes = [tuple(x.shape) for x in inputs]
+        in_dtypes = [x.dtype for x in inputs]
+        _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+        _, out_types, _ = prop.infer_type(list(in_dtypes))
+        result_spec = tuple(jax.ShapeDtypeStruct(tuple(s), t)
+                            for s, t in zip(out_shapes, out_types))
+
+        def host_forward(*np_in):
+            op = prop.create_operator(None, in_shapes, in_dtypes)
+            in_nd = _as_ndarrays(np_in)
+            out_nd = _as_ndarrays([np.zeros(s, t)
+                                   for s, t in zip(out_shapes, out_types)])
+            op.forward(is_train=True, req=["write"] * len(out_nd),
+                       in_data=in_nd, out_data=out_nd, aux=[])
+            return tuple(np.asarray(o.asnumpy(), t)
+                         for o, t in zip(out_nd, out_types))
+
+        def host_backward(*np_all):
+            grads = np_all[:n_out]
+            ins = np_all[n_out:n_out + len(in_shapes)]
+            outs = np_all[n_out + len(in_shapes):]
+            op = prop.create_operator(None, in_shapes, in_dtypes)
+            in_nd = _as_ndarrays(ins)
+            out_nd = _as_ndarrays(outs)
+            grad_nd = _as_ndarrays(grads)
+            igrad_nd = _as_ndarrays([np.zeros(s, d)
+                                     for s, d in zip(in_shapes, in_dtypes)])
+            op.backward(req=["write"] * len(igrad_nd), out_grad=grad_nd,
+                        in_data=in_nd, out_data=out_nd, in_grad=igrad_nd,
+                        aux=[])
+            return tuple(np.asarray(g.asnumpy(), d)
+                         for g, d in zip(igrad_nd, in_dtypes))
+
+        @jax.custom_vjp
+        def run(*xs):
+            out = jax.pure_callback(host_forward, result_spec, *xs)
+            return tuple(out) if n_out > 1 else out[0]
+
+        def run_fwd(*xs):
+            out = jax.pure_callback(host_forward, result_spec, *xs)
+            res = tuple(out) if n_out > 1 else out[0]
+            return res, (xs, tuple(out))
+
+        def run_bwd(saved, cts):
+            xs, outs = saved
+            cts_t = cts if isinstance(cts, tuple) else (cts,)
+            in_spec = tuple(jax.ShapeDtypeStruct(tuple(s), d)
+                            for s, d in zip(in_shapes, in_dtypes))
+            gin = jax.pure_callback(host_backward, in_spec,
+                                    *cts_t, *xs, *outs)
+            return tuple(gin)
+
+        run.defvjp(run_fwd, run_bwd)
+        return run(*inputs)
+
+    return Operator("_custom_" + op_type, fcompute, num_inputs=n_in,
+                    num_outputs=n_out, input_names=input_names,
+                    doc="Custom op %r (prop %s; ref: operator.py register)"
+                        % (op_type, prop_cls.__name__))
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp under ``op_type=reg_name``
+    (ref: operator.py register / MXCustomOpRegister)."""
+
+    def dec(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise TypeError("register must wrap a CustomOpProp subclass")
+        _CUSTOM_PROPS[reg_name] = prop_cls
+        op = _make_custom_operator(reg_name, prop_cls)
+        _REGISTRY[op.name] = op
+        return prop_cls
+
+    return dec
+
+
+def get_all_registered_operators():
+    return sorted(_CUSTOM_PROPS)
+
+
+def _dispatch_custom(op_type):
+    try:
+        return _REGISTRY["_custom_" + op_type]
+    except KeyError:
+        raise MXNetError("Custom op type %r is not registered "
+                         "(have: %s)" % (op_type,
+                                         get_all_registered_operators()))
+
+
+def custom_nd(*args, op_type=None, **kwargs):
+    """``nd.Custom(*data, op_type='name', **params)``
+    (ref: custom.cc Custom op)."""
+    from .ndarray.ndarray import invoke
+    if op_type is None:
+        raise TypeError("Custom requires op_type=")
+    op = _dispatch_custom(op_type)
+    out = kwargs.pop("out", None)
+    name = kwargs.pop("name", None)
+    return invoke(op, list(args), kwargs, out=out)
+
+
+def custom_sym(*args, op_type=None, name=None, **kwargs):
+    """``sym.Custom(*data, op_type='name', **params)``."""
+    from .symbol.symbol import _make_node
+    if op_type is None:
+        raise TypeError("Custom requires op_type=")
+    op = _dispatch_custom(op_type)
+    return _make_node(op, list(args), kwargs, name=name)

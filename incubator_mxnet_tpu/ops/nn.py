@@ -83,7 +83,7 @@ def _fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False, flatt
 
 @register("Convolution", num_inputs=None,
           input_names=("data", "weight", "bias"),
-          finfer_params=_conv_param_shapes)
+          finfer_params=_conv_param_shapes, aliases=("Convolution_v1",))
 def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
                  num_filter=0, num_group=1, no_bias=False, workspace=1024,
                  cudnn_tune=None, cudnn_off=False, layout=None):

@@ -65,7 +65,8 @@ class Operator:
                  differentiable: bool = True, needs_rng: bool = False,
                  takes_is_train: bool = False, nograd_inputs=(), mutate_inputs=(),
                  input_names=None, aux_input_names=(), fargnames=None,
-                 finfer_params=None, fvisible=None, doc: str = ""):
+                 finfer_params=None, fvisible=None, fnum_outputs=None,
+                 doc: str = ""):
         self.name = name
         self.fcompute = fcompute
         self.num_inputs = num_inputs
@@ -82,6 +83,7 @@ class Operator:
         self.fargnames = fargnames
         self.finfer_params = finfer_params
         self.fvisible = fvisible
+        self.fnum_outputs = fnum_outputs   # params → output count (split etc.)
         self.doc = doc
         self._jit_cache: dict = {}
 

@@ -82,8 +82,50 @@ def _layer_scan(x, h0, c0, w_ih, w_hh, b_ih, b_hh, mode, reverse=False):
     return ys, hT, cT
 
 
+def _rnn_argnames(p):
+    """Named inputs in the op's positional order (data, states, then
+    layer-major/dir-inner weight+bias arrays — rnn-inl.h packing order)."""
+    mode = p.get("mode", "lstm")
+    layers = int(p.get("num_layers", 1))
+    dirs = 2 if p.get("bidirectional") else 1
+    names = ["data", "state"] + (["state_cell"] if mode == "lstm" else [])
+    prefixes = ["%s%d" % ("lr"[d], l) for l in range(layers)
+                for d in range(dirs)]
+    for pre in prefixes:
+        names += ["%s_i2h_weight" % pre, "%s_h2h_weight" % pre]
+    for pre in prefixes:
+        names += ["%s_i2h_bias" % pre, "%s_h2h_bias" % pre]
+    return names
+
+
+def _rnn_param_shapes(data_shape, p):
+    """Back-fill weight shapes from the TNC data shape (ref: rnn-inl.h
+    RNNParam inferring the fused blob size)."""
+    mode = p.get("mode", "lstm")
+    gates = _GATES[mode]
+    h = int(p.get("state_size", 0))
+    layers = int(p.get("num_layers", 1))
+    dirs = 2 if p.get("bidirectional") else 1
+    c = data_shape[2]
+    shapes = {}
+    for l in range(layers):
+        in_dim = c if l == 0 else dirs * h
+        for d in range(dirs):
+            pre = "%s%d" % ("lr"[d], l)
+            shapes["%s_i2h_weight" % pre] = (gates * h, in_dim)
+            shapes["%s_h2h_weight" % pre] = (gates * h, h)
+            shapes["%s_i2h_bias" % pre] = (gates * h,)
+            shapes["%s_h2h_bias" % pre] = (gates * h,)
+    n_states = layers * dirs
+    shapes["state"] = (n_states, data_shape[1], h)
+    shapes["state_cell"] = (n_states, data_shape[1], h)
+    return shapes
+
+
 @register("RNN", num_inputs=None, needs_rng=True, takes_is_train=True,
-          num_outputs=3, fvisible=lambda p, n: n if p.get("state_outputs") else 1)
+          num_outputs=3, fargnames=_rnn_argnames,
+          finfer_params=_rnn_param_shapes,
+          fvisible=lambda p, n: n if p.get("state_outputs") else 1)
 def _rnn(*inputs, state_size=0, num_layers=1, bidirectional=False, mode="lstm",
          p=0.0, state_outputs=False, lstm_state_clip_min=None,
          lstm_state_clip_max=None, rng=None, is_train=False):

@@ -136,7 +136,8 @@ def _stack(*args, axis=0, num_args=None):
     return jnp.stack(args, axis=axis)
 
 
-@register("SliceChannel", num_inputs=1, num_outputs=1, aliases=("split",))
+@register("SliceChannel", num_inputs=1, num_outputs=1, aliases=("split",),
+          fnum_outputs=lambda p: int(p.get("num_outputs", 1)))
 def _split(data, num_outputs=1, axis=1, squeeze_axis=False):
     """ref: src/operator/slice_channel.cc — returns a list of outputs.
 
@@ -401,3 +402,45 @@ def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axi
         src = jnp.where(t < L[None, :], L[None, :] - 1 - t, t)  # (T,N)
         out = jnp.take_along_axis(x, src.reshape((T, x.shape[1]) + (1,) * (x.ndim - 2)), axis=0)
     return jnp.moveaxis(out, 0, axis)
+
+
+@register("hard_sigmoid", num_inputs=1)
+def _hard_sigmoid(data, alpha=0.2, beta=0.5):
+    """ref: src/operator/mshadow_op.h hard_sigmoid — clip(a·x + b, 0, 1)."""
+    return jnp.clip(alpha * data + beta, 0.0, 1.0)
+
+
+@register("square_sum", num_inputs=1)
+def _square_sum(data, axis=None, keepdims=False, exclude=False):
+    """Fused sum(x²) (ref: src/operator/tensor/square_sum.cc — the
+    row-sparse fast path lives on the NDArray surface; this is the dense
+    registered op so Symbol graphs can reach it)."""
+    ax = None if axis is None else (axis if isinstance(axis, (tuple, list))
+                                    else (axis,))
+    if ax is not None and exclude:
+        ax = tuple(i for i in range(data.ndim) if i not in
+                   tuple(a % data.ndim for a in ax))
+    return jnp.sum(data * data, axis=ax, keepdims=keepdims)
+
+
+@register("_cast_storage_dense", num_inputs=1, aliases=("cast_storage",))
+def _cast_storage_op(data, stype="default"):
+    """Registered twin of sparse.cast_storage (ref:
+    src/operator/tensor/cast_storage.cc).  Inside a compiled graph every
+    tensor is dense; 'row_sparse'/'csr' requests are honored at the
+    NDArray surface (ndarray/sparse.py cast_storage), so here the values
+    pass through unchanged — the graph stays correct, the storage
+    optimization applies in eager mode."""
+    return data
+
+
+@register("_sparse_retain_dense", num_inputs=2, nograd_inputs=(1,),
+          aliases=("sparse_retain",))
+def _sparse_retain_op(data, indices):
+    """Zero all rows except ``indices`` (ref:
+    src/operator/tensor/sparse_retain.cc).  Dense semantics of the same
+    contract; the rsp fast path is ndarray/sparse.py retain."""
+    idx = indices.astype(jnp.int32)
+    mask = jnp.zeros((data.shape[0],), jnp.bool_).at[idx].set(True)
+    return jnp.where(mask.reshape((-1,) + (1,) * (data.ndim - 1)),
+                     data, jnp.zeros_like(data))

@@ -1,0 +1,12 @@
+"""Legacy symbolic RNN API (ref: python/mxnet/rnn/__init__.py).
+
+``mx.rnn`` predates Gluon: cells compose Symbol graphs for use with the
+Module/BucketingModule path, with ``BucketSentenceIter`` feeding bucketed
+batches.  The Gluon-era cells live in ``mx.gluon.rnn``.
+"""
+from .rnn_cell import *
+from .io import *
+from .rnn import *
+from . import rnn_cell
+from . import io
+from . import rnn

@@ -57,3 +57,10 @@ _register.populate(sys.modules[__name__].__dict__)
 # sub-namespaces for parity: sym.linalg, sym.contrib
 from . import linalg  # noqa: E402,F401
 from . import contrib  # noqa: E402,F401
+
+
+def Custom(*args, **kwargs):
+    """Python-defined custom op node (ref: src/operator/custom/custom.cc;
+    register via mx.operator.register)."""
+    from ..operator import custom_sym
+    return custom_sym(*args, **kwargs)

@@ -585,11 +585,9 @@ def _make_node(op, inputs, params, name=None):
     hint = op.name.lower().lstrip("_")
     final_name = NameManager.current().get(name, hint)
     attrs = attribute.current().get(None)
-    n_out = op.num_outputs
-    s = Symbol(op, list(inputs), params, final_name, n_out, attrs=attrs)
-    if n_out == 1:
-        return s
-    return s
+    n_out = (op.fnum_outputs(params) if op.fnum_outputs is not None
+             else op.num_outputs)
+    return Symbol(op, list(inputs), params, final_name, n_out, attrs=attrs)
 
 
 def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
@@ -661,7 +659,9 @@ def load_json(json_str):
                 ins.append(src if out_i == 0 and src.num_outputs == 1
                            else src[out_i])
             op = get_op(op_name)
-            s = Symbol(op, ins, parsed, entry["name"], op.num_outputs,
+            n_out = (op.fnum_outputs(parsed) if op.fnum_outputs is not None
+                     else op.num_outputs)
+            s = Symbol(op, ins, parsed, entry["name"], n_out,
                        attrs=sym_attr)
         nodes.append(s)
     heads = [nodes[nid] if out_i == 0 and nodes[nid].num_outputs == 1

@@ -1,0 +1,212 @@
+"""CustomOp registry, mx.rnn legacy cells, BucketSentenceIter, gap ops.
+
+Parity models: tests/python/unittest/test_operator.py test_custom_op,
+test_rnn.py (cell unroll shapes), rnn/io.py BucketSentenceIter usage.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+# ---------------------------------------------------------------------------
+# mx.operator CustomOp
+# ---------------------------------------------------------------------------
+
+class _Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], nd.array(1 / (1 + np.exp(-x))))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        g = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], nd.array(g * y * (1 - y)))
+
+
+@mx.operator.register("testsigmoid")
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return _Sigmoid()
+
+
+def test_custom_op_nd_forward_backward():
+    x = nd.array(np.array([[-1.0, 0.0, 2.0]], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="testsigmoid")
+        loss = nd.sum(y)
+    loss.backward()
+    ref = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(y.asnumpy(), ref, rtol=1e-5)
+    assert_almost_equal(x.grad.asnumpy(), ref * (1 - ref), rtol=1e-5)
+
+
+def test_custom_op_symbol_graph():
+    data = mx.sym.var("data")
+    s = mx.sym.Custom(data, op_type="testsigmoid", name="cust")
+    exe = s.simple_bind(ctx=mx.cpu(), data=(2, 3))
+    x = nd.array(np.random.randn(2, 3).astype(np.float32))
+    out = exe.forward(is_train=True, data=x)[0]
+    ref = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-5)
+    exe.backward(nd.ones((2, 3)))
+    assert_almost_equal(exe.grad_dict["data"].asnumpy(), ref * (1 - ref),
+                        rtol=1e-5)
+
+
+def test_custom_op_registry_listing():
+    assert "testsigmoid" in mx.operator.get_all_registered_operators()
+    with pytest.raises(mx.MXNetError):
+        nd.Custom(nd.ones((1,)), op_type="not_registered")
+
+
+# ---------------------------------------------------------------------------
+# gap ops: hard_sigmoid / square_sum / cast_storage / sparse_retain
+# ---------------------------------------------------------------------------
+
+def test_hard_sigmoid():
+    x = nd.array(np.array([-10.0, -1.0, 0.0, 1.0, 10.0], np.float32))
+    out = nd.hard_sigmoid(x, alpha=0.2, beta=0.5)
+    assert_almost_equal(out.asnumpy(),
+                        np.clip(0.2 * x.asnumpy() + 0.5, 0, 1), rtol=1e-6)
+
+
+def test_square_sum_op():
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    out = nd.square_sum(nd.array(x), axis=1)
+    assert_almost_equal(out.asnumpy(), (x * x).sum(axis=1), rtol=1e-5)
+    # reachable from symbol graphs too
+    s = mx.sym.square_sum(mx.sym.var("data"), axis=0)
+    got = s.eval_dict({"data": nd.array(x)})
+    assert_almost_equal(got.asnumpy(), (x * x).sum(axis=0), rtol=1e-5)
+
+
+def test_sparse_retain_op():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    idx = nd.array(np.array([0, 2], np.float32))
+    out = nd.sparse_retain(nd.array(x), idx)
+    expect = np.zeros_like(x)
+    expect[[0, 2]] = x[[0, 2]]
+    assert_almost_equal(out.asnumpy(), expect, rtol=1e-6)
+
+
+def test_cast_storage_op_symbol():
+    x = np.random.RandomState(1).randn(2, 3).astype(np.float32)
+    s = mx.sym.cast_storage(mx.sym.var("data"), stype="default")
+    got = s.eval_dict({"data": nd.array(x)})
+    assert_almost_equal(got.asnumpy(), x, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# mx.rnn cells
+# ---------------------------------------------------------------------------
+
+def test_rnn_cell_unroll_shapes():
+    for cell, width in [(mx.rnn.RNNCell(8, prefix="r_"), 8),
+                        (mx.rnn.LSTMCell(8, prefix="l_"), 8),
+                        (mx.rnn.GRUCell(8, prefix="g_"), 8)]:
+        out, states = cell.unroll(4, mx.sym.var("data"), merge_outputs=True)
+        exe = out.simple_bind(ctx=mx.cpu(), data=(2, 4, 5))
+        r = exe.forward(is_train=False,
+                        data=nd.array(np.random.randn(2, 4, 5)
+                                      .astype(np.float32)))[0]
+        assert r.shape == (2, 4, width), type(cell).__name__
+
+
+def test_rnn_stack_residual_dropout():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.GRUCell(8, prefix="g1_"))
+    stack.add(mx.rnn.DropoutCell(0.2))
+    stack.add(mx.rnn.ResidualCell(mx.rnn.GRUCell(8, prefix="g2_")))
+    out, states = stack.unroll(3, mx.sym.var("data"), merge_outputs=True)
+    exe = out.simple_bind(ctx=mx.cpu(), data=(2, 3, 8))
+    r = exe.forward(is_train=False, data=nd.ones((2, 3, 8)))[0]
+    assert r.shape == (2, 3, 8)
+    assert len(states) == 2
+
+
+def test_rnn_bidirectional():
+    bic = mx.rnn.BidirectionalCell(mx.rnn.RNNCell(4, prefix="l_"),
+                                   mx.rnn.RNNCell(4, prefix="r_"))
+    out, _ = bic.unroll(3, mx.sym.var("data"), merge_outputs=True)
+    exe = out.simple_bind(ctx=mx.cpu(), data=(2, 3, 6))
+    r = exe.forward(is_train=False, data=nd.ones((2, 3, 6)))[0]
+    assert r.shape == (2, 3, 8)
+
+
+def test_fused_rnn_cell_and_unfuse():
+    fused = mx.rnn.FusedRNNCell(8, num_layers=2, mode="lstm",
+                                bidirectional=True, prefix="f_")
+    out, _ = fused.unroll(5, mx.sym.var("data"), layout="NTC")
+    exe = out.simple_bind(ctx=mx.cpu(), data=(2, 5, 6))
+    r = exe.forward(is_train=False,
+                    data=nd.array(np.random.randn(2, 5, 6)
+                                  .astype(np.float32)))[0]
+    assert r.shape == (2, 5, 16)
+    stack = fused.unfuse()
+    out2, _ = stack.unroll(5, mx.sym.var("data"), merge_outputs=True)
+    exe2 = out2.simple_bind(ctx=mx.cpu(), data=(2, 5, 6))
+    r2 = exe2.forward(is_train=False, data=nd.ones((2, 5, 6)))[0]
+    assert r2.shape == (2, 5, 16)
+
+
+def test_fused_matches_unfused_lstm():
+    """Same weights → identical outputs for fused vs step-unrolled LSTM."""
+    rng = np.random.RandomState(7)
+    H, C, T, N = 4, 3, 3, 2
+    fused = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="f_")
+    of, _ = fused.unroll(T, mx.sym.var("data"), layout="NTC")
+    ef = of.simple_bind(ctx=mx.cpu(), data=(N, T, C))
+    w_i2h = rng.randn(4 * H, C).astype(np.float32)
+    w_h2h = rng.randn(4 * H, H).astype(np.float32)
+    b_i2h = rng.randn(4 * H).astype(np.float32)
+    b_h2h = rng.randn(4 * H).astype(np.float32)
+    ef.copy_params_from({"f_l0_i2h_weight": nd.array(w_i2h),
+                         "f_l0_h2h_weight": nd.array(w_h2h),
+                         "f_l0_i2h_bias": nd.array(b_i2h),
+                         "f_l0_h2h_bias": nd.array(b_h2h)},
+                        allow_extra_params=True)
+    x = rng.randn(N, T, C).astype(np.float32)
+    rf = ef.forward(is_train=False, data=nd.array(x))[0].asnumpy()
+
+    cell = mx.rnn.LSTMCell(H, prefix="u_")
+    ou, _ = cell.unroll(T, mx.sym.var("data"), merge_outputs=True)
+    eu = ou.simple_bind(ctx=mx.cpu(), data=(N, T, C))
+    eu.copy_params_from({"u_i2h_weight": nd.array(w_i2h),
+                         "u_h2h_weight": nd.array(w_h2h),
+                         "u_i2h_bias": nd.array(b_i2h),
+                         "u_h2h_bias": nd.array(b_h2h)},
+                        allow_extra_params=True)
+    ru = eu.forward(is_train=False, data=nd.array(x))[0].asnumpy()
+    assert_almost_equal(rf, ru, rtol=1e-4, atol=1e-5)
+
+
+def test_bucket_sentence_iter():
+    sents = [[1, 2, 3], [2, 3], [1, 2, 3, 4, 5], [3, 4], [1, 2], [2, 2, 2]]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=2, buckets=[3, 5],
+                                   invalid_label=0)
+    keys = set()
+    count = 0
+    for batch in it:
+        assert batch.data[0].shape[0] == 2
+        assert batch.data[0].shape[1] == batch.bucket_key
+        # label is next-token shift of data
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        assert (l[:, :-1] == d[:, 1:]).all()
+        keys.add(batch.bucket_key)
+        count += 1
+    assert count >= 2 and keys <= {3, 5}
+
+
+def test_encode_sentences():
+    sents = [["a", "b"], ["b", "c"]]
+    coded, vocab = mx.rnn.encode_sentences(sents, start_label=1)
+    assert len(vocab) >= 3
+    assert coded[0][1] == coded[1][0]   # same token "b" → same id
