@@ -91,20 +91,21 @@ def predict_mode():
 
 
 class TapeNode:
-    __slots__ = ("op", "inputs", "outputs", "vjp", "used")
+    __slots__ = ("op", "inputs", "outputs", "vjp", "fn", "used")
 
-    def __init__(self, op, inputs, outputs, vjp):
+    def __init__(self, op, inputs, outputs, vjp, fn=None):
         self.op = op
         self.inputs = inputs      # list[NDArray] (strong refs keep tape valid)
         self.outputs = outputs    # list[NDArray]
         self.vjp = vjp
+        self.fn = fn              # pure fn of inputs (higher-order replay)
         self.used = False
 
 
-def _record(op, inputs, outputs, vjp_fn):
+def _record(op, inputs, outputs, vjp_fn, fn=None):
     """Called by ndarray.invoke under recording (RecordOp, imperative.cc:182)."""
     s = _st()
-    node = TapeNode(op, inputs, outputs, vjp_fn)
+    node = TapeNode(op, inputs, outputs, vjp_fn, fn)
     for i, o in enumerate(outputs):
         o._tape_ref = (node, i)
     s.tape.append(node)
@@ -121,21 +122,43 @@ def mark_variables(variables, gradients, grad_reqs="write"):
 
 def _run_backward(heads, head_grads, retain_graph, train_mode, variables=None,
                   create_graph=False):
+    """Reverse pass over the tape (RunGraph over the gradient graph,
+    imperative.cc:268).
+
+    Plain mode accumulates raw device values.  With ``create_graph`` the
+    pass runs *as recorded eager ops*: each vjp application becomes a new
+    tape node whose inputs are the primal inputs plus the incoming
+    cotangents (so second derivatives see both dependencies), and
+    cotangent accumulation goes through the recorded add op — the
+    returned gradients are ordinary tape-connected NDArrays.
+    """
     s = _st()
-    tape = s.tape
+    tape = list(s.tape)
     grads: dict[int, object] = {}
-    # seed
+
+    from ..ndarray.ndarray import NDArray, invoke
+    from ..ops.registry import get_op
+
+    def _seed(h, hg):
+        v = jnp.ones_like(h._read()) if hg is None else hg._read()
+        return NDArray(v) if create_graph else v
+
     for i, h in enumerate(heads):
-        hg = None if head_grads is None else head_grads[i]
-        if hg is None:
-            seed = jnp.ones_like(h._read())
+        grads[id(h)] = _seed(h, None if head_grads is None
+                             else head_grads[i])
+
+    def _zero_ct(o):
+        z = jnp.zeros_like(o._read())
+        return NDArray(z) if create_graph else z
+
+    def _accum(key, g):
+        if key not in grads:
+            grads[key] = g
+        elif create_graph:
+            grads[key] = invoke(get_op("elemwise_add"), [grads[key], g], {})
         else:
-            seed = hg._read()
-        grads[id(h)] = seed
+            grads[key] = grads[key] + g
 
-    var_ids = None if variables is None else {id(v): v for v in variables}
-
-    # reverse pass over the tape
     for node in reversed(tape):
         if not any(id(o) in grads for o in node.outputs):
             continue
@@ -143,46 +166,43 @@ def _run_backward(heads, head_grads, retain_graph, train_mode, variables=None,
             raise RuntimeError(
                 "graph already backpropagated; use retain_graph=True "
                 "(parity: mxnet 'hit a node twice' check)")
-        out_cts = tuple(
-            grads.get(id(o), jnp.zeros_like(o._read())) for o in node.outputs)
-        ct = out_cts[0] if len(out_cts) == 1 else out_cts
+        out_cts = tuple(grads.get(id(o)) if id(o) in grads else _zero_ct(o)
+                        for o in node.outputs)
         if create_graph:
-            in_cts = _recorded_vjp(node, ct)
+            in_cts = _recorded_vjp(node, out_cts)
         else:
+            ct = out_cts[0] if len(out_cts) == 1 else out_cts
             in_cts = node.vjp(ct)
         for idx, (inp, g) in enumerate(zip(node.inputs, in_cts)):
             if idx in node.op.nograd_inputs or g is None:
                 continue
-            key = id(inp)
-            if key in grads:
-                grads[key] = grads[key] + g
-            else:
-                grads[key] = g
+            _accum(id(inp), g)
         if not retain_graph:
             node.used = True
 
-    # deliver into .grad buffers (or return for grad())
     results = None
-    if var_ids is not None:
+    if variables is not None:
         results = []
         for v in variables:
             g = grads.get(id(v))
             if g is None:
-                g = jnp.zeros_like(v._read())
+                g = _zero_ct(v)
             results.append(g)
     for node in tape:
         for arr in node.inputs:
-            _deliver(arr, grads)
+            _deliver(arr, grads, create_graph)
     for h in heads:
-        _deliver(h, grads)
+        _deliver(h, grads, create_graph)
     if not retain_graph and not create_graph:
-        s.tape = [n for n in tape if not n.used]
+        s.tape = [n for n in s.tape if not n.used]
     return results
 
 
-def _deliver(arr, grads):
+def _deliver(arr, grads, as_ndarray=False):
     if arr._grad is not None and arr._grad_req != "null" and id(arr) in grads:
         g = grads[id(arr)]
+        if as_ndarray:
+            g = g._read()
         if arr._grad_req == "add":
             arr._grad._write(arr._grad._read() + g)
         else:
@@ -190,15 +210,44 @@ def _deliver(arr, grads):
         grads.pop(id(arr))
 
 
-def _recorded_vjp(node, ct):
-    """Apply a node's vjp while re-recording it on the tape (higher-order)."""
-    from ..ndarray.ndarray import NDArray
+def _recorded_vjp(node, ct_nds):
+    """Apply one node's backward as a *recorded* op (higher-order path).
 
-    s = _st()
-    # The cotangent may itself be an NDArray-producing recorded value; here we
-    # treat it as a raw value and re-record the vjp application as one node.
-    out_vals, vjp2 = jax.vjp(node.vjp, ct)
-    return out_vals[0] if isinstance(out_vals, tuple) and len(out_vals) == 1 else out_vals
+    Builds g(primals..., cts...) = vjp(node.fn at primals)(cts) and runs it
+    through the same record machinery as any eager op, so the produced
+    input-cotangents carry tape edges to both the primal inputs and the
+    incoming cotangents — exactly the dependency set the reference's
+    backward-of-backward graph has (pass::Gradient applied twice).
+    """
+    from ..ndarray.ndarray import NDArray
+    from ..ops.registry import Operator
+
+    n_in = len(node.inputs)
+    if node.fn is None:
+        # nodes without a replayable fn (custom Function): first-order only
+        raw = node.vjp(tuple(c._read() for c in ct_nds)
+                       if len(ct_nds) > 1 else ct_nds[0]._read())
+        return tuple(NDArray(g) if g is not None else None for g in raw)
+
+    def gfun(*args):
+        prim = args[:n_in]
+        cts = args[n_in:]
+        out, vjp_fn = jax.vjp(node.fn, *prim)
+        ct = cts[0] if len(cts) == 1 else tuple(cts)
+        res = vjp_fn(ct)
+        # single-output nodes hand their vjp a bare leaf (tape convention)
+        return res[0] if n_in == 1 else res
+
+    all_inputs = list(node.inputs) + list(ct_nds)
+    vals = [a._read() for a in all_inputs]
+    out_vals, vjp2 = jax.vjp(gfun, *vals)
+    if not isinstance(out_vals, tuple):
+        out_vals = (out_vals,)
+    outs = [NDArray(v) for v in out_vals]
+    bop = Operator("_backward_" + node.op.name, gfun,
+                   num_inputs=len(all_inputs), num_outputs=len(outs))
+    _record(bop, all_inputs, outs, vjp2, fn=gfun)
+    return outs
 
 
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
@@ -214,34 +263,17 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
 
     if retain_graph is None:
         retain_graph = create_graph
-    with _scope(training=train_mode):
+    # create_graph must record its own vjp/accumulation ops even when the
+    # caller sits outside a record() scope (the reference's higher-order
+    # backward always builds the grad-of-grad graph)
+    with _scope(training=train_mode,
+                recording=True if create_graph else None):
         raw = _run_backward(heads, head_grads, retain_graph, train_mode,
                             variables=variables, create_graph=create_graph)
-    outs = [NDArray(g, ctx=v._ctx) for g, v in zip(raw, variables)]
     if create_graph:
-        # re-record: make returned grads differentiable by replaying through
-        # a recorded identity-of-vjp composite. We record one composite node
-        # whose vjp is the full second-order vjp chain.
-        _record_grad_graph(heads, variables, outs, head_grads)
-    return outs
-
-
-def _record_grad_graph(heads, variables, grad_outs, head_grads):
-    """Record grads as outputs of a composite op so grads-of-grads work."""
-    from ..ops.registry import Operator
-
-    vals = [v._read() for v in variables]
-
-    def composite(*var_vals):
-        # rebuild forward functionally via jax.grad on a closure of the tape
-        # — supported only for single-head scalar cases, the common pattern
-        # (loss.backward style). Falls back silently otherwise.
-        raise NotImplementedError
-
-    # Higher-order support is handled through jax.vjp inside _recorded_vjp;
-    # full replay-based re-recording lands with the symbolic executor where
-    # the whole graph is available as one function.
-    return
+        # already tape-connected NDArrays (see _recorded_vjp)
+        return list(raw)
+    return [NDArray(g, ctx=v._ctx) for g, v in zip(raw, variables)]
 
 
 def get_symbol(x):

@@ -45,40 +45,44 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer(object):
-    """samples/sec logging (ref: callback.py:120 class Speedometer)."""
+    """Throughput logger: every ``frequent`` batches, report samples/sec
+    over the window just completed, plus current metric values
+    (ref: callback.py:120 class Speedometer — same batch_end_callback
+    contract, re-implemented around a window-start timestamp).
+    """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
-        self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
+        self.frequent = max(1, int(frequent))
         self.auto_reset = auto_reset
+        self._window_start = None     # (nbatch, wall time) at window open
+        self._pending = 0
+
+    def _metrics_text(self, metric):
+        if metric is None:
+            return ""
+        pairs = metric.get_name_value()
+        if self.auto_reset:
+            metric.reset()
+        return "".join(" %s=%.6f" % nv for nv in pairs)
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count, speed,
-                                 *sum(name_value, ()))
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
-        else:
-            self.init = True
-            self.tic = time.time()
+        now = time.time()
+        if self._window_start is None or param.nbatch < self._pending:
+            # first batch of an epoch (or restart): open a fresh window
+            self._window_start = (param.nbatch, now)
+            self._pending = param.nbatch
+            return
+        self._pending = param.nbatch
+        start_batch, start_time = self._window_start
+        if param.nbatch - start_batch < self.frequent:
+            return
+        elapsed = max(now - start_time, 1e-9)
+        rate = (param.nbatch - start_batch) * self.batch_size / elapsed
+        logging.info("epoch %d batch %d: %.2f samples/sec%s",
+                     param.epoch, param.nbatch, rate,
+                     self._metrics_text(param.eval_metric))
+        self._window_start = (param.nbatch, now)
 
 
 class ProgressBar(object):

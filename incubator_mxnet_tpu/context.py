@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import threading
 
+from .base import MXNetError
+
 __all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_devices"]
 
 
@@ -127,3 +129,31 @@ def num_devices(device_type="tpu"):
         except RuntimeError:
             return 1
     return len(jax.devices())
+
+
+def gpu_memory_info(device_id=0):
+    """(free, total) bytes on an accelerator device (ref: context.py
+    gpu_memory_info → cudaMemGetInfo; here XLA's per-device allocator
+    stats — the storage-manager accounting of SURVEY §2.1)."""
+    for ctx_type in ("tpu", "gpu"):
+        try:
+            dev = Context(ctx_type, device_id).jax_device()
+            break
+        except Exception:
+            dev = None
+    if dev is None:
+        raise MXNetError("no accelerator device %d" % device_id)
+    stats = dev.memory_stats() or {}
+    total = stats.get("bytes_limit", 0)
+    used = stats.get("bytes_in_use", 0)
+    return total - used, total
+
+
+def memory_stats(ctx=None):
+    """Full allocator statistics for a context (pool stats parity:
+    src/storage/pooled_storage_manager.h — XLA's BFC allocator is the
+    pool here; keys include bytes_in_use, peak_bytes_in_use,
+    num_allocs, bytes_limit when the backend reports them)."""
+    ctx = ctx or current_context()
+    dev = ctx.jax_device()
+    return dict(dev.memory_stats() or {})

@@ -135,16 +135,24 @@ class KVStore(object):
         keys, outs = self._normalize(key, out)
         if isinstance(row_ids, NDArray):
             row_ids = [row_ids] * len(outs[0])
+        from .ndarray.sparse import RowSparseNDArray
         for k, olist in zip(keys, outs):
             src = self._store[k]._read()
             for o, rid in zip(olist, row_ids):
-                idx = rid._read().astype(jnp.int32)
+                # dedup + sort row ids (PullRowSparseImpl contract)
+                idx = jnp.asarray(np.unique(np.asarray(rid._read()))
+                                  .astype(np.int32))
                 rows = jnp.take(src, idx, axis=0)
-                # scatter selected rows into dense out, rest zero (row_sparse
-                # semantic projected onto dense TPU storage)
-                dense = jnp.zeros(o.shape, o._read().dtype)
-                dense = dense.at[idx].set(rows.astype(o._read().dtype))
-                o._write(dense)
+                if isinstance(o, RowSparseNDArray):
+                    # true row-sparse pull: only the requested rows
+                    # materialize — O(|row_ids|) memory like the
+                    # reference's PullRowSparseImpl (kvstore_local.h)
+                    o.data = NDArray(rows.astype(o.data.dtype))
+                    o.indices = NDArray(idx.astype(o.indices.dtype))
+                else:
+                    dense = jnp.zeros(o.shape, o._read().dtype)
+                    dense = dense.at[idx].set(rows.astype(o._read().dtype))
+                    o._write(dense)
 
     # -- reductions --------------------------------------------------------
     @staticmethod

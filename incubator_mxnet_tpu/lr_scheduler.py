@@ -1,99 +1,121 @@
-"""Learning-rate schedulers (ref: python/mxnet/lr_scheduler.py).
+"""Learning-rate schedules (ref: python/mxnet/lr_scheduler.py).
 
-Same three schedulers as the reference (Factor / MultiFactor / Poly), same
-``scheduler(num_update)`` call contract used by ``Optimizer._get_lr``.
+Same scheduler(num_update) → lr call contract as the reference's Factor /
+MultiFactor / Poly schedulers, re-derived as *closed-form* functions of
+``num_update``: the reference mutates ``base_lr`` in a while-loop, which
+makes schedules history-dependent; computing the decay count directly
+gives identical values for the monotonically-increasing ``num_update``
+stream optimizers produce, and stays correct if a scheduler is probed
+out of order (e.g. when resuming from a checkpoint).
 """
 from __future__ import annotations
 
 import logging
+import math
 
-__all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler", "PolyScheduler"]
+__all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
+           "PolyScheduler", "CosineScheduler"]
 
 
 class LRScheduler(object):
-    """Base scheduler: maps num_update → lr (ref: lr_scheduler.py:24)."""
+    """Base: subclasses implement ``__call__(num_update) -> lr``
+    (ref: lr_scheduler.py:24; consumed by Optimizer._get_lr)."""
 
     def __init__(self, base_lr=0.01):
         self.base_lr = base_lr
+        self._last_logged = None
+
+    def _log_if_changed(self, num_update, lr):
+        if lr != self._last_logged:
+            self._last_logged = lr
+            logging.info("lr schedule: update %d -> %.5e", num_update, lr)
 
     def __call__(self, num_update):
         raise NotImplementedError
 
 
 class FactorScheduler(LRScheduler):
-    """lr *= factor every `step` updates (ref: lr_scheduler.py FactorScheduler)."""
+    """Multiply by ``factor`` every ``step`` updates, floored at
+    ``stop_factor_lr`` (ref: lr_scheduler.py FactorScheduler)."""
 
     def __init__(self, step, factor=1, stop_factor_lr=1e-8):
         super().__init__()
         if step < 1:
-            raise ValueError("Schedule step must be greater or equal than 1 round")
+            raise ValueError("step must be >= 1")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError("factor must be <= 1 so the lr decays")
         self.step = step
         self.factor = factor
         self.stop_factor_lr = stop_factor_lr
-        self.count = 0
 
     def __call__(self, num_update):
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
-                logging.info("Update[%d]: now learning rate arrived at %0.5e, "
-                             "will not change in the future", num_update, self.base_lr)
-            else:
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-        return self.base_lr
+        n_decays = max(0, (int(num_update) - 1) // self.step)
+        lr = self.base_lr * self.factor ** n_decays
+        lr = max(lr, self.stop_factor_lr)
+        self._log_if_changed(num_update, lr)
+        return lr
 
 
 class MultiFactorScheduler(LRScheduler):
-    """lr *= factor at each listed step (ref: lr_scheduler.py MultiFactorScheduler)."""
+    """Multiply by ``factor`` at each milestone in ``step``
+    (ref: lr_scheduler.py MultiFactorScheduler)."""
 
     def __init__(self, step, factor=1):
         super().__init__()
-        assert isinstance(step, list) and len(step) >= 1
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing integer list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal than 1 round")
+        if not isinstance(step, list) or not step:
+            raise ValueError("step must be a non-empty increasing list")
+        if any(s < 1 for s in step) or \
+                any(later <= earlier
+                    for earlier, later in zip(step, step[1:])):
+            raise ValueError("step must be an increasing list of ints >= 1")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
-        self.step = step
-        self.cur_step_ind = 0
+            raise ValueError("factor must be <= 1 so the lr decays")
+        self.step = list(step)
         self.factor = factor
-        self.count = 0
 
     def __call__(self, num_update):
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-            else:
-                return self.base_lr
-        return self.base_lr
+        n_decays = sum(1 for s in self.step if num_update > s)
+        lr = self.base_lr * self.factor ** n_decays
+        self._log_if_changed(num_update, lr)
+        return lr
 
 
 class PolyScheduler(LRScheduler):
-    """Polynomial decay to zero at max_update (ref: lr_scheduler.py PolyScheduler)."""
+    """base_lr · (1 - t/T)^power, zero after T updates
+    (ref: lr_scheduler.py PolyScheduler)."""
 
     def __init__(self, max_update, base_lr=0.01, pwr=2):
         super().__init__(base_lr=base_lr)
-        assert isinstance(max_update, int)
-        if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly positive")
-        self.base_lr_orig = self.base_lr
-        self.max_update = max_update
+        if int(max_update) < 1:
+            raise ValueError("max_update must be >= 1")
+        self.max_update = int(max_update)
         self.power = pwr
-        self.base_lr = self.base_lr_orig
 
     def __call__(self, num_update):
-        if num_update <= self.max_update:
-            self.base_lr = self.base_lr_orig * pow(
-                1.0 - float(num_update) / float(self.max_update), self.power)
-        return self.base_lr
+        t = min(int(num_update), self.max_update)
+        return self.base_lr * (1.0 - t / self.max_update) ** self.power
+
+
+class CosineScheduler(LRScheduler):
+    """Cosine decay from base_lr to final_lr over max_update, with an
+    optional linear warmup — the modern large-batch default (no direct
+    reference twin; LBSGD covers warmup in the reference)."""
+
+    def __init__(self, max_update, base_lr=0.01, final_lr=0.0,
+                 warmup_steps=0, warmup_begin_lr=0.0):
+        super().__init__(base_lr=base_lr)
+        self.max_update = int(max_update)
+        self.final_lr = final_lr
+        self.warmup_steps = int(warmup_steps)
+        self.warmup_begin_lr = warmup_begin_lr
+
+    def __call__(self, num_update):
+        t = int(num_update)
+        if t < self.warmup_steps:
+            return self.warmup_begin_lr + (self.base_lr -
+                                           self.warmup_begin_lr) * \
+                t / max(1, self.warmup_steps)
+        span = max(1, self.max_update - self.warmup_steps)
+        frac = min(1.0, (t - self.warmup_steps) / span)
+        return self.final_lr + 0.5 * (self.base_lr - self.final_lr) * \
+            (1.0 + math.cos(math.pi * frac))

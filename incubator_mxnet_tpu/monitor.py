@@ -48,44 +48,40 @@ class Monitor(object):
         self.exes.append(exe)
 
     def tic(self):
-        """Start collecting for this step (ref: monitor.py tic)."""
-        if self.step % self.interval == 0:
-            for exe in self.exes:
-                for array in exe.arg_arrays:
-                    array.wait_to_read()
+        """Open a collection window if this step is due
+        (ref: monitor.py tic contract)."""
+        self.activated = self.step % self.interval == 0
+        if self.activated:
             self.queue = []
-            self.activated = True
         self.step += 1
 
+    def _fmt(self, value):
+        if isinstance(value, NDArray):
+            value = value.asnumpy()
+        if isinstance(value, (list, tuple)):
+            return "  ".join(self._fmt(v) for v in value)
+        return str(value)
+
     def toc(self):
-        """Finish a step; returns collected stats (ref: monitor.py toc)."""
+        """Close the window: append matching *parameter* stats to the
+        layer-output stats gathered by the executor tap, and return
+        [(step, name, formatted stat)] (ref: monitor.py toc contract)."""
         if not self.activated:
             return []
+        self.activated = False
         for exe in self.exes:
-            for array in exe.arg_arrays:
-                array.wait_to_read()
-        for exe in self.exes:
+            exe.outputs and exe.outputs[0].wait_to_read()
             for name, array in zip(exe._arg_names, exe.arg_arrays):
                 if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(array)))
-        self.activated = False
-        res = []
-        if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            if not isinstance(v_list, list):
-                v_list = [v_list]
-            s = ""
-            for v in v_list:
-                s += str(v) + "\t"
-            res.append((n, k, s))
+                    self.queue.append((self.step, name,
+                                       self.stat_func(array)))
+        entries = sorted(self.queue, key=lambda e: e[1]) if self.sort \
+            else self.queue
         self.queue = []
-        return res
+        return [(step, name, self._fmt(stat))
+                for step, name, stat in entries]
 
     def toc_print(self):
-        """ref: monitor.py toc_print."""
-        res = self.toc()
-        for n, k, v in res:
-            logging.info("Batch: %7d %30s %s", n, k, v)
+        """Log everything toc() collected (ref: monitor.py toc_print)."""
+        for step, name, text in self.toc():
+            logging.info("monitor step %d  %s: %s", step, name, text)

@@ -33,6 +33,12 @@ from .. import config as _config
 # read once at import like dmlc::GetEnv's static locals.
 _NAIVE_ENGINE = _config.naive_engine()
 
+# trailing window of dispatched outputs for waitall() (WaitForAll);
+# deque of weakrefs — dead entries mean the buffer was already collected
+import collections as _collections
+import weakref as _weakref
+_RECENT_DISPATCHES = _collections.deque(maxlen=32)
+
 __all__ = ["NDArray", "array", "empty", "invoke", "waitall",
            "concatenate", "moveaxis", "imperative_invoke"]
 
@@ -550,6 +556,11 @@ def invoke(op: Operator, inputs, params, out=None):
         _span.__exit__()
     if _NAIVE_ENGINE:
         jax.block_until_ready(out_vals)
+    try:
+        _RECENT_DISPATCHES.append(_weakref.ref(
+            out_vals[0] if isinstance(out_vals, tuple) else out_vals))
+    except TypeError:
+        pass    # value type without weakref support
 
     if not isinstance(out_vals, tuple):
         out_vals = (out_vals,)
@@ -563,7 +574,7 @@ def invoke(op: Operator, inputs, params, out=None):
     out_arrays = [NDArray(v, ctx=ctx) for v in out_vals]
 
     if recording:
-        autograd._record(op, list(inputs), out_arrays, vjp_fn)
+        autograd._record(op, list(inputs), out_arrays, vjp_fn, fn=wrapped)
 
     n_visible = op.visible_outputs(params, len(out_arrays))
 
@@ -628,10 +639,22 @@ def empty(shape, ctx=None, dtype="float32"):
 
 
 def waitall():
-    """ref: mx.nd.waitall → Engine WaitForAll."""
-    # XLA async dispatch: blocking on live buffers is unnecessary for
-    # correctness; provided for API parity and benchmarking.
-    (jax.device_put(0.0) + 0).block_until_ready()
+    """Block until all outstanding work has executed
+    (ref: mx.nd.waitall → Engine::WaitForAll, threaded_engine.cc).
+
+    XLA executes computations in dispatch order per device stream, so
+    draining the queue = blocking on the most recently dispatched outputs.
+    ``invoke`` keeps weak references to its latest results per thread; a
+    small trailing window is retained in case a backend completes buffers
+    out of order."""
+    for ref in list(_RECENT_DISPATCHES):
+        arr = ref()
+        if arr is not None:
+            try:
+                jax.block_until_ready(arr)
+            except Exception:       # deleted/donated buffers: already done
+                pass
+    _RECENT_DISPATCHES.clear()
 
 
 def concatenate(arrays, axis=0, always_copy=True):

@@ -1,76 +1,219 @@
-"""NDArray save/load (ref: src/ndarray/ndarray.cc NDArray::Save/Load,
-python/mxnet/ndarray/utils.py save/load).
+"""NDArray save/load in the MXNet binary container format.
 
-Format: numpy .npz with a manifest — functionally equivalent to the
-reference's dmlc::Stream binary container (named or unnamed array lists,
-sparse-aware).  Files written by this module round-trip dense and sparse
-arrays with names preserved.
+Byte-compatible with the reference (src/ndarray/ndarray.cc
+NDArray::Save/Load + the list container written by MXNDArraySave,
+src/c_api/c_api.cc): ``.params`` files written here load in stock MXNet
+and vice versa — including sparse arrays and the V1/legacy dense
+formats on read.  Files from this module's earlier private .npz format
+are still recognized and loaded.
+
+Layout (little-endian):
+  uint64 0x112 (kMXAPINDArrayListMagic), uint64 reserved
+  uint64 n_arrays, then per array NDArray::Save:
+      uint32 0xF993fac9 (V2 magic), int32 stype,
+      [storage_shape if sparse], shape, int32 dev_type, int32 dev_id,
+      int32 dtype flag, [aux dtypes+shapes], raw data, [raw aux data]
+  uint64 n_names, then per name: uint64 len + bytes
+Shapes are uint32 ndim + int64[ndim] (nnvm::Tuple::Save).
 """
 from __future__ import annotations
 
-import json
+import struct
 
 import numpy as np
 
 __all__ = ["save", "load"]
 
-_MAGIC = "mxtpu-ndarray-v1"
+_LIST_MAGIC = 0x112
+_NDARRAY_V2_MAGIC = 0xF993FAC9
+_NDARRAY_V1_MAGIC = 0xF993FAC8
+
+# mshadow type flags (mshadow/base.h)
+_FLAG_OF = {np.dtype("float32"): 0, np.dtype("float64"): 1,
+            np.dtype("float16"): 2, np.dtype("uint8"): 3,
+            np.dtype("int32"): 4, np.dtype("int8"): 5,
+            np.dtype("int64"): 6}
+_DTYPE_OF = {v: k for k, v in _FLAG_OF.items()}
+
+_STYPE_DEFAULT, _STYPE_ROW_SPARSE, _STYPE_CSR = 0, 1, 2
+
+
+def _write_shape(out, shape):
+    out.append(struct.pack("<I", len(shape)))
+    out.append(struct.pack("<%dq" % len(shape), *shape))
+
+
+def _dtype_flag(dtype):
+    dtype = np.dtype(dtype)
+    if dtype not in _FLAG_OF:
+        raise ValueError("dtype %s not representable in the MXNet binary "
+                         "format (bfloat16 et al.: cast to float32 first)"
+                         % dtype)
+    return _FLAG_OF[dtype]
+
+
+def _save_one(out, arr):
+    from .sparse import RowSparseNDArray, CSRNDArray
+    out.append(struct.pack("<I", _NDARRAY_V2_MAGIC))
+    if isinstance(arr, RowSparseNDArray):
+        data = np.ascontiguousarray(arr.data.asnumpy())
+        aux = [np.ascontiguousarray(arr.indices.asnumpy().astype(np.int64))]
+        out.append(struct.pack("<i", _STYPE_ROW_SPARSE))
+        _write_shape(out, data.shape)          # storage shape
+    elif isinstance(arr, CSRNDArray):
+        data = np.ascontiguousarray(arr.data.asnumpy())
+        # aux order kIndPtr, kIdx (include/mxnet/ndarray.h csr enum)
+        aux = [np.ascontiguousarray(arr.indptr.asnumpy().astype(np.int64)),
+               np.ascontiguousarray(arr.indices.asnumpy().astype(np.int64))]
+        out.append(struct.pack("<i", _STYPE_CSR))
+        _write_shape(out, data.shape)
+    else:
+        a = arr.asnumpy()
+        if a.dtype not in _FLAG_OF:   # e.g. bfloat16 → widen
+            a = a.astype(np.float32)
+        if a.ndim == 0:
+            # MXNet 1.x has no 0-d arrays (ndim 0 encodes "empty"); the
+            # value survives as shape (1,)
+            a = a.reshape(1)
+        data = np.ascontiguousarray(a)
+        aux = []
+        out.append(struct.pack("<i", _STYPE_DEFAULT))
+    _write_shape(out, data.shape if not aux else arr.shape)
+    out.append(struct.pack("<ii", 1, 0))       # Context: kCPU, dev_id 0
+    out.append(struct.pack("<i", _dtype_flag(data.dtype)))
+    for a in aux:
+        out.append(struct.pack("<i", _dtype_flag(a.dtype)))
+        _write_shape(out, a.shape)
+    out.append(data.tobytes())
+    for a in aux:
+        out.append(a.tobytes())
 
 
 def save(fname, data):
+    """Write arrays (list or name→array dict) as a .params file
+    (ref: python/mxnet/ndarray/utils.py save → MXNDArraySave)."""
     from .ndarray import NDArray
-    from .sparse import RowSparseNDArray, CSRNDArray
 
     if isinstance(data, NDArray):
         data = [data]
-    payload = {}
-    manifest = {"magic": _MAGIC, "entries": []}
     if isinstance(data, dict):
-        items = list(data.items())
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
     else:
-        items = [(None, v) for v in data]
-    for i, (name, arr) in enumerate(items):
-        ent = {"name": name, "idx": i}
-        if isinstance(arr, RowSparseNDArray):
-            ent["stype"] = "row_sparse"
-            ent["shape"] = list(arr.shape)
-            payload["a%d_data" % i] = arr.data.asnumpy()
-            payload["a%d_indices" % i] = arr.indices.asnumpy()
-        elif isinstance(arr, CSRNDArray):
-            ent["stype"] = "csr"
-            ent["shape"] = list(arr.shape)
-            payload["a%d_data" % i] = arr.data.asnumpy()
-            payload["a%d_indices" % i] = arr.indices.asnumpy()
-            payload["a%d_indptr" % i] = arr.indptr.asnumpy()
-        else:
-            ent["stype"] = "default"
-            payload["a%d_data" % i] = arr.asnumpy()
-        manifest["entries"].append(ent)
-    payload["__manifest__"] = np.frombuffer(
-        json.dumps(manifest).encode(), dtype=np.uint8)
+        names = []
+        arrays = list(data)
+    out = [struct.pack("<QQ", _LIST_MAGIC, 0),
+           struct.pack("<Q", len(arrays))]
+    for arr in arrays:
+        _save_one(out, arr)
+    out.append(struct.pack("<Q", len(names)))
+    for n in names:
+        b = n.encode()
+        out.append(struct.pack("<Q", len(b)))
+        out.append(b)
     with open(fname, "wb") as f:
-        np.savez(f, **payload)
+        f.write(b"".join(out))
 
 
-def load(fname):
+class _Reader:
+    def __init__(self, buf):
+        self.buf = buf
+        self.pos = 0
+
+    def read(self, fmt):
+        size = struct.calcsize(fmt)
+        vals = struct.unpack_from(fmt, self.buf, self.pos)
+        self.pos += size
+        return vals if len(vals) > 1 else vals[0]
+
+    def read_bytes(self, n):
+        b = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return b
+
+    def read_shape(self):
+        ndim = self.read("<I")
+        if ndim == 0:
+            return ()
+        return tuple(self.read("<%dq" % ndim)) if ndim > 1 \
+            else (self.read("<q"),)
+
+
+def _load_one(r):
     from .ndarray import array
     from . import sparse
+    magic = r.read("<I")
+    if magic != _NDARRAY_V2_MAGIC:
+        # V1 / legacy dense format (ref: NDArray::LegacyLoad)
+        if magic == _NDARRAY_V1_MAGIC:
+            shape = r.read_shape()
+        else:
+            # pre-V1: the "magic" is ndim, dims are uint32
+            ndim = magic
+            shape = tuple(r.read("<%dI" % ndim)) if ndim > 1 \
+                else ((r.read("<I"),) if ndim else ())
+        if not shape:
+            return array(np.zeros((0,), np.float32))
+        r.read("<ii")                      # context
+        flag = r.read("<i")
+        dtype = _DTYPE_OF[flag]
+        n = int(np.prod(shape))
+        data = np.frombuffer(r.read_bytes(n * dtype.itemsize),
+                             dtype=dtype).reshape(shape)
+        return array(data)
 
+    stype = r.read("<i")
+    nad = {_STYPE_DEFAULT: 0, _STYPE_ROW_SPARSE: 1, _STYPE_CSR: 2}[stype]
+    sshape = r.read_shape() if nad > 0 else None
+    shape = r.read_shape()
+    if not shape:
+        # ndim 0 = empty NDArray: nothing else on the stream
+        # (ref: NDArray::Save early return after shape for is_none())
+        return array(np.zeros((0,), np.float32))
+    r.read("<ii")                          # context
+    flag = r.read("<i")
+    dtype = _DTYPE_OF[flag]
+    aux_specs = []
+    for _ in range(nad):
+        aflag = r.read("<i")
+        ashape = r.read_shape()
+        aux_specs.append((_DTYPE_OF[aflag], ashape))
+    dshape = sshape if nad > 0 else shape
+    n = int(np.prod(dshape)) if dshape else 0
+    data = np.frombuffer(r.read_bytes(n * dtype.itemsize),
+                         dtype=dtype).reshape(dshape)
+    aux = []
+    for adtype, ashape in aux_specs:
+        cnt = int(np.prod(ashape)) if ashape else 0
+        aux.append(np.frombuffer(r.read_bytes(cnt * adtype.itemsize),
+                                 dtype=adtype).reshape(ashape))
+    if stype == _STYPE_ROW_SPARSE:
+        return sparse.row_sparse_array((data, aux[0]), shape=shape)
+    if stype == _STYPE_CSR:
+        indptr, indices = aux
+        return sparse.csr_matrix((data, indices, indptr), shape=shape)
+    return array(data)
+
+
+def _load_legacy_npz(fname):
+    """Reader for this module's earlier private .npz container."""
+    import json
+    from .ndarray import array
+    from . import sparse
     with np.load(fname) as z:
         manifest = json.loads(bytes(z["__manifest__"].tobytes()).decode())
-        if manifest.get("magic") != _MAGIC:
-            raise ValueError("not a %s file" % _MAGIC)
         named = any(e["name"] for e in manifest["entries"])
         out_list, out_dict = [], {}
         for e in manifest["entries"]:
             i = e["idx"]
             if e["stype"] == "row_sparse":
                 arr = sparse.row_sparse_array(
-                    (z["a%d_data" % i], z["a%d_indices" % i]), shape=tuple(e["shape"]))
+                    (z["a%d_data" % i], z["a%d_indices" % i]),
+                    shape=tuple(e["shape"]))
             elif e["stype"] == "csr":
                 arr = sparse.csr_matrix(
-                    (z["a%d_data" % i], z["a%d_indices" % i], z["a%d_indptr" % i]),
-                    shape=tuple(e["shape"]))
+                    (z["a%d_data" % i], z["a%d_indices" % i],
+                     z["a%d_indptr" % i]), shape=tuple(e["shape"]))
             else:
                 arr = array(z["a%d_data" % i])
             if named:
@@ -78,3 +221,25 @@ def load(fname):
             else:
                 out_list.append(arr)
     return out_dict if named else out_list
+
+
+def load(fname):
+    """Load a .params file (MXNet binary; legacy npz sniffed by header)
+    (ref: python/mxnet/ndarray/utils.py load → MXNDArrayLoad)."""
+    with open(fname, "rb") as f:
+        head = f.read(8)
+        if head[:2] == b"PK":              # zip → legacy npz container
+            return _load_legacy_npz(fname)
+        buf = head + f.read()
+    r = _Reader(buf)
+    magic, _reserved = r.read("<QQ")
+    if magic != _LIST_MAGIC:
+        raise ValueError("not an MXNet NDArray file (bad magic 0x%x)"
+                         % magic)
+    n = r.read("<Q")
+    arrays = [_load_one(r) for _ in range(n)]
+    n_names = r.read("<Q")
+    names = [r.read_bytes(r.read("<Q")).decode() for _ in range(n_names)]
+    if names:
+        return dict(zip(names, arrays))
+    return arrays

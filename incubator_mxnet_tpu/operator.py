@@ -98,20 +98,21 @@ def _as_ndarrays(np_arrays):
 
 
 def _make_custom_operator(op_type, prop_cls):
-    """Build a registry Operator for one registered CustomOpProp."""
+    """Build a registry Operator for one registered CustomOpProp.
+
+    The prop is instantiated lazily with the call-site params (stock
+    MXNet's pattern — props commonly have required __init__ args), so
+    arity and output count are functions of the params via
+    fargnames/fnum_outputs."""
 
     def make_prop(params):
         kwargs = {k: str(v) for k, v in params.items()
-                  if k not in ("op_type",)}
+                  if k not in ("op_type", "is_train")}
         return prop_cls(**kwargs)
 
-    sample = make_prop({})
-    n_in = len(sample.list_arguments())
-    n_out = len(sample.list_outputs())
-    input_names = tuple(sample.list_arguments())
-
-    def fcompute(*inputs, **params):
+    def fcompute(*inputs, is_train=False, **params):
         prop = make_prop(params)
+        n_out = len(prop.list_outputs())
         in_shapes = [tuple(x.shape) for x in inputs]
         in_dtypes = [x.dtype for x in inputs]
         _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
@@ -124,7 +125,9 @@ def _make_custom_operator(op_type, prop_cls):
             in_nd = _as_ndarrays(np_in)
             out_nd = _as_ndarrays([np.zeros(s, t)
                                    for s, t in zip(out_shapes, out_types)])
-            op.forward(is_train=True, req=["write"] * len(out_nd),
+            # req='write' mirrors the reference's imperative dispatch
+            # (graph-planned kAddTo never reaches eager custom calls)
+            op.forward(is_train=is_train, req=["write"] * len(out_nd),
                        in_data=in_nd, out_data=out_nd, aux=[])
             return tuple(np.asarray(o.asnumpy(), t)
                          for o, t in zip(out_nd, out_types))
@@ -167,8 +170,15 @@ def _make_custom_operator(op_type, prop_cls):
         run.defvjp(run_fwd, run_bwd)
         return run(*inputs)
 
-    return Operator("_custom_" + op_type, fcompute, num_inputs=n_in,
-                    num_outputs=n_out, input_names=input_names,
+    def fargnames(params):
+        return list(make_prop(params).list_arguments())
+
+    def fnum_outputs(params):
+        return len(make_prop(params).list_outputs())
+
+    return Operator("_custom_" + op_type, fcompute, num_inputs=None,
+                    num_outputs=1, takes_is_train=True,
+                    fargnames=fargnames, fnum_outputs=fnum_outputs,
                     doc="Custom op %r (prop %s; ref: operator.py register)"
                         % (op_type, prop_cls.__name__))
 

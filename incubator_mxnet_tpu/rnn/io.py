@@ -76,7 +76,11 @@ class BucketSentenceIter(DataIter):
             buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
             buff[:len(sent)] = sent
             self.data[buck].append(buff)
-        self.data = [np.asarray(b, dtype=dtype) for b in self.data]
+        # empty buckets become (0, bucket_len) so downstream 2-D slicing
+        # works; they simply contribute no batches
+        self.data = [np.asarray(b, dtype=dtype) if b
+                     else np.zeros((0, blen), dtype=dtype)
+                     for b, blen in zip(self.data, buckets)]
         if ndiscard:
             logging.warning("discarded %d sentences longer than the largest "
                             "bucket", ndiscard)

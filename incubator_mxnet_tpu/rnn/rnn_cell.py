@@ -125,6 +125,27 @@ class BaseRNNCell(object):
                 nd.concatenate(bias)
         return args
 
+    def _resolve_begin_state(self, states, step_ref):
+        """Replace underdetermined zero-states (shape containing 0, i.e.
+        batch unknown — what the default ``begin_state()`` produces, as in
+        the reference) with states derived from the data symbol.  States
+        with concrete shapes pass through untouched."""
+        if states is None:
+            return self._derived_begin_state(step_ref)
+        derived = None
+        out = []
+        for i, s_ in enumerate(states):
+            under = (not s_.is_variable() and s_._op is not None
+                     and s_._op.name in ("zeros", "_zeros")
+                     and 0 in tuple(s_._params.get("shape", (0,))))
+            if under:
+                if derived is None:
+                    derived = self._derived_begin_state(step_ref)
+                out.append(derived[i])
+            else:
+                out.append(s_)
+        return out
+
     def _derived_begin_state(self, step_ref):
         """Zero states shaped from a per-step (N, C) input symbol.
 
@@ -151,9 +172,7 @@ class BaseRNNCell(object):
         (ref: rnn_cell.py BaseRNNCell.unroll)."""
         self.reset()
         inputs, _ = _normalize_sequence(length, inputs, layout, False)
-        if begin_state is None:
-            begin_state = self._derived_begin_state(inputs[0])
-        states = begin_state
+        states = self._resolve_begin_state(begin_state, inputs[0])
         outputs = []
         for i in range(length):
             output, states = self(inputs[i], states)
@@ -368,12 +387,10 @@ class FusedRNNCell(BaseRNNCell):
         if axis == 1:
             # RNN op wants TNC
             inputs = symbol.swapaxes(inputs, dim1=0, dim2=1)
-        if begin_state is None:
-            # (N, C) zero reference collapsed over time (TNC axis 0)
-            step0 = symbol.sum(inputs * 0, axis=0,
-                               name="%sstate_ref" % self._prefix)
-            begin_state = self._derived_begin_state(step0)
-        states = list(begin_state)
+        # (N, C) zero reference collapsed over time (TNC axis 0)
+        step0 = symbol.sum(inputs * 0, axis=0,
+                           name="%sstate_ref" % self._prefix)
+        states = list(self._resolve_begin_state(begin_state, step0))
         outputs = symbol.RNN(inputs, *states, *self._weight_vars,
                              state_size=self._num_hidden,
                              num_layers=self._num_layers,

@@ -1,0 +1,162 @@
+"""MXNet binary .params format + rewritten scheduler/callback behavior.
+
+Parity model: src/ndarray/ndarray.cc NDArray::Save/Load byte layout,
+tests/python/unittest/test_ndarray.py save/load round trips.
+"""
+import struct
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_params_roundtrip_named(tmp_path):
+    f = str(tmp_path / "m.params")
+    rng = np.random.RandomState(0)
+    data = {"arg:w": nd.array(rng.randn(3, 4).astype(np.float32)),
+            "arg:b": nd.array(rng.randn(4).astype(np.float64)),
+            "aux:m": nd.array(rng.randint(0, 9, (2, 2)).astype(np.int32))}
+    nd.save(f, data)
+    back = nd.load(f)
+    assert set(back) == set(data)
+    for k in data:
+        assert back[k].dtype == data[k].dtype
+        assert_almost_equal(back[k].asnumpy(), data[k].asnumpy(), rtol=1e-7)
+
+
+def test_params_roundtrip_list_and_sparse(tmp_path):
+    f = str(tmp_path / "l.params")
+    dense = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    rsp = nd.sparse.row_sparse_array(
+        (np.array([[1, 2], [3, 4]], np.float32), np.array([0, 2], np.int64)),
+        shape=(4, 2))
+    csr = nd.sparse.csr_matrix(
+        (np.array([5, 6], np.float32), np.array([1, 0], np.int64),
+         np.array([0, 1, 2], np.int64)), shape=(2, 2))
+    nd.save(f, [dense, rsp, csr])
+    back = nd.load(f)
+    assert isinstance(back, list) and len(back) == 3
+    assert_almost_equal(back[0].asnumpy(), dense.asnumpy(), rtol=1e-7)
+    assert back[1].stype == "row_sparse"
+    assert_almost_equal(back[1].asnumpy(), rsp.asnumpy(), rtol=1e-7)
+    assert back[2].stype == "csr"
+    assert_almost_equal(back[2].asnumpy(), csr.asnumpy(), rtol=1e-7)
+
+
+def test_params_binary_layout(tmp_path):
+    """Byte-level check against the reference container constants
+    (src/ndarray/ndarray.cc: list magic 0x112, V2 magic 0xF993fac9,
+    uint32-ndim + int64-dims shapes, int32 dtype flags)."""
+    f = str(tmp_path / "b.params")
+    nd.save(f, {"x": nd.array(np.array([[1.5, -2.0]], np.float32))})
+    raw = open(f, "rb").read()
+    magic, reserved, count = struct.unpack_from("<QQQ", raw, 0)
+    assert magic == 0x112 and reserved == 0 and count == 1
+    off = 24
+    v2, stype = struct.unpack_from("<Ii", raw, off)
+    assert v2 == 0xF993FAC9 and stype == 0
+    off += 8
+    ndim = struct.unpack_from("<I", raw, off)[0]
+    assert ndim == 2
+    dims = struct.unpack_from("<2q", raw, off + 4)
+    assert dims == (1, 2)
+    off += 4 + 16
+    dev_type, dev_id, flag = struct.unpack_from("<iii", raw, off)
+    assert dev_type == 1 and flag == 0      # kCPU, kFloat32
+    off += 12
+    vals = struct.unpack_from("<2f", raw, off)
+    assert vals == (1.5, -2.0)
+
+
+def test_params_reads_reference_written_file(tmp_path):
+    """A file assembled byte-by-byte the way stock MXNet writes it loads
+    correctly (simulates checkpoint interop without the reference lib)."""
+    f = str(tmp_path / "ref.params")
+    payload = np.array([3.0, 4.0, 5.0], np.float32)
+    blob = struct.pack("<QQQ", 0x112, 0, 1)
+    blob += struct.pack("<Ii", 0xF993FAC9, 0)          # V2, dense
+    blob += struct.pack("<Iq", 1, 3)                   # shape (3,)
+    blob += struct.pack("<ii", 1, 0)                   # cpu ctx
+    blob += struct.pack("<i", 0)                       # float32
+    blob += payload.tobytes()
+    name = b"arg:weight"
+    blob += struct.pack("<Q", 1) + struct.pack("<Q", len(name)) + name
+    open(f, "wb").write(blob)
+    out = nd.load(f)
+    assert list(out) == ["arg:weight"]
+    assert_almost_equal(out["arg:weight"].asnumpy(), payload, rtol=1e-7)
+
+
+def test_params_reads_v1_legacy_array(tmp_path):
+    """V1 (pre-storage-type) dense arrays load (NDArray::LegacyLoad)."""
+    f = str(tmp_path / "v1.params")
+    payload = np.array([[7, 8]], np.int32)
+    blob = struct.pack("<QQQ", 0x112, 0, 1)
+    blob += struct.pack("<I", 0xF993FAC8)              # V1 magic
+    blob += struct.pack("<I2q", 2, 1, 2)               # shape (1,2)
+    blob += struct.pack("<ii", 1, 0)                   # cpu ctx
+    blob += struct.pack("<i", 4)                       # int32
+    blob += payload.tobytes()
+    blob += struct.pack("<Q", 0)                       # unnamed
+    open(f, "wb").write(blob)
+    out = nd.load(f)
+    assert out[0].dtype == np.int32
+    assert (out[0].asnumpy() == payload).all()
+
+
+def test_checkpoint_save_load_through_model(tmp_path):
+    """model.save_checkpoint/load_checkpoint over the binary format."""
+    prefix = str(tmp_path / "ck")
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+    arg = {"fc_weight": nd.array(np.ones((3, 4), np.float32)),
+           "fc_bias": nd.array(np.zeros(3, np.float32))}
+    mx.model.save_checkpoint(prefix, 7, net, arg, {})
+    sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 7)
+    assert set(arg2) == set(arg)
+    assert_almost_equal(arg2["fc_weight"].asnumpy(),
+                        arg["fc_weight"].asnumpy(), rtol=1e-7)
+
+
+def test_lr_schedulers_closed_form():
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 1.0
+    assert s(1) == 1.0 and s(10) == 1.0
+    assert s(11) == 0.5 and s(21) == 0.25
+    # out-of-order probing gives the same answers (stateless)
+    assert s(11) == 0.5 and s(1) == 1.0
+
+    m = mx.lr_scheduler.MultiFactorScheduler(step=[5, 8], factor=0.1)
+    m.base_lr = 1.0
+    assert m(5) == 1.0 and abs(m(6) - 0.1) < 1e-12
+    assert abs(m(9) - 0.01) < 1e-12
+
+    p = mx.lr_scheduler.PolyScheduler(max_update=10, base_lr=1.0, pwr=2)
+    assert p(0) == 1.0 and p(10) == 0.0
+    assert abs(p(5) - 0.25) < 1e-12
+
+    c = mx.lr_scheduler.CosineScheduler(max_update=10, base_lr=1.0,
+                                        warmup_steps=2, warmup_begin_lr=0.0)
+    assert c(0) == 0.0 and c(1) == 0.5
+    assert abs(c(2) - 1.0) < 1e-12 and abs(c(10)) < 1e-9
+
+
+def test_speedometer_logs(caplog):
+    import logging
+    sp = mx.callback.Speedometer(batch_size=4, frequent=2, auto_reset=False)
+
+    class P:
+        epoch = 0
+        eval_metric = None
+
+    with caplog.at_level(logging.INFO):
+        for nbatch in range(5):
+            p = P()
+            p.nbatch = nbatch
+            sp(p)
+    msgs = [r.message for r in caplog.records if "samples/sec" in r.message]
+    assert len(msgs) >= 2

@@ -1,0 +1,102 @@
+"""Higher-order autograd, waitall, row_sparse_pull, memory accounting.
+
+Parity models: tests/python/unittest/test_autograd.py (grad with
+create_graph), test_kvstore.py row-sparse pulls, reference
+Engine::WaitForAll contract.
+"""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_second_order_grad():
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        g1 = autograd.grad([nd.sum(y)], [x], create_graph=True,
+                           retain_graph=True)[0]
+        z = nd.sum(g1)
+    z.backward()
+    assert_almost_equal(x.grad.asnumpy(), 6 * x.asnumpy(), rtol=1e-5)
+
+
+def test_third_order_grad():
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x * x
+        g1 = autograd.grad([nd.sum(y)], [x], create_graph=True,
+                           retain_graph=True)[0]
+        g2 = autograd.grad([nd.sum(g1)], [x], create_graph=True,
+                           retain_graph=True)[0]
+        w = nd.sum(g2)
+    w.backward()
+    assert_almost_equal(x.grad.asnumpy(), 24 * x.asnumpy(), rtol=1e-5)
+
+
+def test_second_order_through_mixed_graph():
+    x = nd.array(np.array([0.5, 1.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x) * x
+        g = autograd.grad([nd.sum(y)], [x], create_graph=True,
+                          retain_graph=True)[0]
+        s = nd.sum(g * g)
+    s.backward()
+    ex = np.exp(x.asnumpy())
+    xv = x.asnumpy()
+    expect = 2 * ex * (1 + xv) * ex * (2 + xv)
+    assert_almost_equal(x.grad.asnumpy(), expect, rtol=1e-4)
+
+
+def test_first_order_unaffected():
+    x = nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), [4.0], rtol=1e-6)
+
+
+def test_waitall_blocks_outstanding_work():
+    a = nd.array(np.random.randn(64, 64).astype(np.float32))
+    outs = [nd.dot(a, a) for _ in range(4)]
+    nd.waitall()     # must not raise; after it, results are materialized
+    for o in outs:
+        assert np.isfinite(o.asnumpy()).all()
+
+
+def test_row_sparse_pull_sparse_out():
+    kv = mx.kv.create("local")
+    w = np.arange(12, dtype=np.float32).reshape(4, 3)
+    kv.init("w", nd.array(w))
+    out = nd.sparse.row_sparse_array(
+        (np.zeros((1, 3), np.float32), np.array([0], np.int64)),
+        shape=(4, 3))
+    kv.row_sparse_pull("w", out=out,
+                       row_ids=nd.array(np.array([2, 0, 2], np.float32)))
+    assert out.stype == "row_sparse"
+    assert (out.indices.asnumpy() == [0, 2]).all()   # deduped + sorted
+    assert_almost_equal(out.data.asnumpy(), w[[0, 2]], rtol=1e-7)
+    # only the requested rows are materialized
+    assert out.data.shape == (2, 3)
+
+
+def test_row_sparse_pull_dense_out():
+    kv = mx.kv.create("local")
+    w = np.arange(12, dtype=np.float32).reshape(4, 3)
+    kv.init("w2", nd.array(w))
+    dout = nd.zeros((4, 3))
+    kv.row_sparse_pull("w2", out=dout,
+                       row_ids=nd.array(np.array([1], np.float32)))
+    got = dout.asnumpy()
+    assert_almost_equal(got[1], w[1], rtol=1e-7)
+    assert got[0].sum() == 0 and got[2].sum() == 0
+
+
+def test_memory_stats_api():
+    stats = mx.context.memory_stats(mx.cpu())
+    assert isinstance(stats, dict)   # CPU backend may report no counters
