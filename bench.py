@@ -24,12 +24,39 @@ import numpy as np
 BASELINE_IMG_S = 90.74  # M40, ResNet-50 train batch 32 (docs/faq/perf.md:174)
 
 
+def _tpu_kernel_smoke():
+    """Exercise the Pallas flash-attention kernel on the real chip and
+    check it against the jnp reference path (the TPU-marked smoke subset
+    of the op test strategy — the CPU suite can never reach this code)."""
+    import jax
+    import jax.numpy as jnp
+    if jax.default_backend() != "tpu":
+        return
+    from incubator_mxnet_tpu.ops.attention import (
+        _attention_reference, _flash_forward_pallas)
+    rs = np.random.RandomState(1)
+    for causal in (False, True):
+        q = jnp.asarray(rs.randn(2, 4, 256, 64).astype(np.float32))
+        k = jnp.asarray(rs.randn(2, 4, 256, 64).astype(np.float32))
+        v = jnp.asarray(rs.randn(2, 4, 256, 64).astype(np.float32))
+        got = _flash_forward_pallas(q, k, v, causal, 0.125)
+        # the kernel computes in full f32; hold the jnp reference to the
+        # same precision (TPU default would run its matmuls in bf16)
+        with jax.default_matmul_precision("highest"):
+            ref = _attention_reference(q, k, v, causal, 0.125)
+        err = float(jnp.max(jnp.abs(got - ref)))
+        assert err < 2e-3, "flash kernel mismatch on TPU (causal=%s): %g" \
+            % (causal, err)
+
+
 def main():
     import jax
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import gluon
     from incubator_mxnet_tpu.gluon.model_zoo import vision
     from incubator_mxnet_tpu.parallel import make_mesh, DataParallelTrainer
+
+    _tpu_kernel_smoke()
 
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")

@@ -224,10 +224,14 @@ def _recorded_vjp(node, ct_nds):
 
     n_in = len(node.inputs)
     if node.fn is None:
-        # nodes without a replayable fn (custom Function): first-order only
-        raw = node.vjp(tuple(c._read() for c in ct_nds)
-                       if len(ct_nds) > 1 else ct_nds[0]._read())
-        return tuple(NDArray(g) if g is not None else None for g in raw)
+        # no replayable function → the second derivative cannot exist;
+        # refuse loudly instead of returning silently-disconnected grads
+        raise RuntimeError(
+            "create_graph=True cannot differentiate through %r: its "
+            "backward is an opaque callback with no replayable function "
+            "(autograd.Function). Express it with regular ops or a "
+            "custom op (mx.operator) to get higher-order gradients."
+            % node.op.name)
 
     def gfun(*args):
         prim = args[:n_in]

@@ -368,10 +368,25 @@ class CachedOp(object):
                 return tuple(pv_g[n] for n in param_names) + \
                     tuple(iv_g[i] for i in real_idx)
 
+            raw = entry["raw"]
+            n_par = len(param_names)
+
+            def tape_fn(*vals):
+                # replayable pure function of the tape inputs — lets
+                # autograd's create_graph build grad-of-grad through the
+                # whole compiled block (same rng → same dropout masks)
+                pv = dict(zip(param_names, vals[:n_par]))
+                iv = list(input_vals)
+                for j, idx in enumerate(real_idx):
+                    iv[idx] = vals[n_par + j]
+                outs, _aux = raw(pv, iv, rng)
+                return outs[0] if len(outs) == 1 else tuple(outs)
+
             op = Operator("_CachedOp", lambda *a: a,
                           num_inputs=len(tape_inputs),
                           num_outputs=len(out_arrays))
-            autograd._record(op, tape_inputs, out_arrays, tape_vjp)
+            autograd._record(op, tape_inputs, out_arrays, tape_vjp,
+                             fn=tape_fn)
 
         out, _ = _regroup(out_arrays, entry["out_fmt"])
         return out

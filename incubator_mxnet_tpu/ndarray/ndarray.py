@@ -33,11 +33,12 @@ from .. import config as _config
 # read once at import like dmlc::GetEnv's static locals.
 _NAIVE_ENGINE = _config.naive_engine()
 
-# trailing window of dispatched outputs for waitall() (WaitForAll);
-# deque of weakrefs — dead entries mean the buffer was already collected
-import collections as _collections
-import weakref as _weakref
-_RECENT_DISPATCHES = _collections.deque(maxlen=32)
+# last dispatched output per device for waitall() (WaitForAll): XLA
+# executes in dispatch order per device stream, so blocking on the most
+# recent output of each stream drains it.  Strong refs (one buffer per
+# device) — a collected weakref would only prove the buffer was freed,
+# not that its computation ran.
+_LAST_DISPATCH_PER_DEVICE = {}
 
 __all__ = ["NDArray", "array", "empty", "invoke", "waitall",
            "concatenate", "moveaxis", "imperative_invoke"]
@@ -556,11 +557,14 @@ def invoke(op: Operator, inputs, params, out=None):
         _span.__exit__()
     if _NAIVE_ENGINE:
         jax.block_until_ready(out_vals)
-    try:
-        _RECENT_DISPATCHES.append(_weakref.ref(
-            out_vals[0] if isinstance(out_vals, tuple) else out_vals))
-    except TypeError:
-        pass    # value type without weakref support
+    first = out_vals[0] if isinstance(out_vals, tuple) else out_vals
+    devs = getattr(first, "devices", None)
+    if devs is not None:
+        try:
+            for d in devs():
+                _LAST_DISPATCH_PER_DEVICE[d] = first
+        except Exception:       # tracers inside jit have no devices
+            pass
 
     if not isinstance(out_vals, tuple):
         out_vals = (out_vals,)
@@ -642,19 +646,15 @@ def waitall():
     """Block until all outstanding work has executed
     (ref: mx.nd.waitall → Engine::WaitForAll, threaded_engine.cc).
 
-    XLA executes computations in dispatch order per device stream, so
-    draining the queue = blocking on the most recently dispatched outputs.
-    ``invoke`` keeps weak references to its latest results per thread; a
-    small trailing window is retained in case a backend completes buffers
-    out of order."""
-    for ref in list(_RECENT_DISPATCHES):
-        arr = ref()
-        if arr is not None:
-            try:
-                jax.block_until_ready(arr)
-            except Exception:       # deleted/donated buffers: already done
-                pass
-    _RECENT_DISPATCHES.clear()
+    Blocks on the most recently dispatched output of every device stream
+    — in-order execution per stream makes that equivalent to draining
+    the queues."""
+    for arr in list(_LAST_DISPATCH_PER_DEVICE.values()):
+        try:
+            jax.block_until_ready(arr)
+        except Exception:           # donated/deleted buffers: already done
+            pass
+    _LAST_DISPATCH_PER_DEVICE.clear()
 
 
 def concatenate(arrays, axis=0, always_copy=True):

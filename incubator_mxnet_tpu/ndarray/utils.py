@@ -52,25 +52,35 @@ def _dtype_flag(dtype):
     return _FLAG_OF[dtype]
 
 
+def _widen_if_needed(a):
+    """MXNet 1.x has no container flag for bf16 etc.: widen to f32 with a
+    warning so save never silently fails NOR silently alters data."""
+    if a.dtype in _FLAG_OF:
+        return a
+    import warnings
+    warnings.warn("dtype %s has no MXNet 1.x .params representation; "
+                  "saving as float32 (loads back as float32)" % a.dtype,
+                  stacklevel=4)
+    return a.astype(np.float32)
+
+
 def _save_one(out, arr):
     from .sparse import RowSparseNDArray, CSRNDArray
     out.append(struct.pack("<I", _NDARRAY_V2_MAGIC))
     if isinstance(arr, RowSparseNDArray):
-        data = np.ascontiguousarray(arr.data.asnumpy())
+        data = np.ascontiguousarray(_widen_if_needed(arr.data.asnumpy()))
         aux = [np.ascontiguousarray(arr.indices.asnumpy().astype(np.int64))]
         out.append(struct.pack("<i", _STYPE_ROW_SPARSE))
         _write_shape(out, data.shape)          # storage shape
     elif isinstance(arr, CSRNDArray):
-        data = np.ascontiguousarray(arr.data.asnumpy())
+        data = np.ascontiguousarray(_widen_if_needed(arr.data.asnumpy()))
         # aux order kIndPtr, kIdx (include/mxnet/ndarray.h csr enum)
         aux = [np.ascontiguousarray(arr.indptr.asnumpy().astype(np.int64)),
                np.ascontiguousarray(arr.indices.asnumpy().astype(np.int64))]
         out.append(struct.pack("<i", _STYPE_CSR))
         _write_shape(out, data.shape)
     else:
-        a = arr.asnumpy()
-        if a.dtype not in _FLAG_OF:   # e.g. bfloat16 → widen
-            a = a.astype(np.float32)
+        a = _widen_if_needed(arr.asnumpy())
         if a.ndim == 0:
             # MXNet 1.x has no 0-d arrays (ndim 0 encodes "empty"); the
             # value survives as shape (1,)

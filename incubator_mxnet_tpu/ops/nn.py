@@ -255,6 +255,10 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0
         var = moving_var.astype(jnp.float32)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     inv = lax.rsqrt(var + eps)
+    # normalize in f32 then cast once: x·s + (β − μ·s) folded in bf16
+    # loses the large-mean channels to cancellation (bf16 mantissa ~8
+    # bits), while (x − μ) first keeps only the final rounding; XLA
+    # converts in-register so the HBM traffic stays at input precision
     out = (xf - mean.reshape(bshape)) * \
         (g.astype(jnp.float32) * inv).reshape(bshape) + \
         beta.astype(jnp.float32).reshape(bshape)

@@ -216,6 +216,62 @@ class DataParallelTrainer(object):
                 donate_argnums=(0, 1, 2) if self._donate else ())
         return self._jit_cache[key]
 
+    def compile_multi(self, xs, ys):
+        """Jit K chained steps as ONE XLA program: lax.scan over the step
+        with the (K, batch, ...) data resident on device.  Amortizes
+        per-launch dispatch/RPC overhead K× — the jit-level analogue of
+        the reference engine's op bulking (threaded_engine.h BulkAppend),
+        one level up: whole train steps are the ops being bulked."""
+        key = ("multi", tuple(xs.shape), str(xs.dtype), tuple(ys.shape))
+        if key not in self._jit_cache:
+            repl = NamedSharding(self.mesh, P())
+            batch = NamedSharding(self.mesh, P(None, "dp"))
+            step = self._make_step(train=True)
+
+            def multi(params, opt_state, rng_key, xs, ys, lr):
+                def body(carry, xy):
+                    p, s, k = carry
+                    x, y = xy
+                    p, s, k, loss = step(p, s, k, x, y, lr)
+                    return (p, s, k), loss
+
+                (params, opt_state, rng_key), losses = jax.lax.scan(
+                    body, (params, opt_state, rng_key), (xs, ys))
+                return params, opt_state, rng_key, losses[-1]
+
+            self._jit_cache[key] = jax.jit(
+                multi,
+                in_shardings=(repl, repl, repl, batch, batch, repl),
+                out_shardings=(repl, repl, repl, repl),
+                donate_argnums=(0, 1, 2) if self._donate else ())
+        return self._jit_cache[key]
+
+    def step_multi(self, datas, labels):
+        """Run K chained steps in one launch; ``datas`` (K, batch, ...),
+        ``labels`` (K, batch).  Returns the last step's device loss."""
+        xs = datas._read() if isinstance(datas, NDArray) else jnp.asarray(datas)
+        ys = labels._read() if isinstance(labels, NDArray) else jnp.asarray(labels)
+        if self._params is None:
+            self._gather_params(xs[0])
+        fn = self.compile_multi(xs, ys)
+        repl = NamedSharding(self.mesh, P())
+        batch_sh = NamedSharding(self.mesh, P(None, "dp"))
+        if self._rng_key is None:
+            self._rng_key = jax.device_put(random_state.next_key(), repl)
+        if self._lr_dev is None:
+            self._lr_dev = jax.device_put(jnp.asarray(self._lr, jnp.float32),
+                                          repl)
+        if not (hasattr(xs, "sharding")
+                and xs.sharding.is_equivalent_to(batch_sh, xs.ndim)):
+            xs = jax.device_put(xs, batch_sh)
+        if not (hasattr(ys, "sharding")
+                and ys.sharding.is_equivalent_to(batch_sh, ys.ndim)):
+            ys = jax.device_put(ys, batch_sh)
+        self._params, self._opt_state, self._rng_key, loss_val = fn(
+            self._params, self._opt_state, self._rng_key, xs, ys,
+            self._lr_dev)
+        return loss_val
+
     def step(self, data, label):
         """Run one sharded train step; returns the (host) scalar loss."""
         x = data._read() if isinstance(data, NDArray) else jnp.asarray(data)

@@ -1,0 +1,218 @@
+"""Numeric-gradient sweep over the operator registry.
+
+Parity model: the reference's check_numeric_gradient harness driven
+across test_operator.py (python/mxnet/test_utils.py:792; 5,439-LoC op
+suite).  One parameterized test per op entry: analytic tape gradients
+vs central finite differences on smooth-input samples.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def _rng(seed):
+    return np.random.RandomState(seed)
+
+
+def _arr(shape, seed=0, lo=-1.0, hi=1.0):
+    return nd.array(_rng(seed).uniform(lo, hi, shape).astype(np.float32))
+
+
+def _pos(shape, seed=0, lo=0.3, hi=2.0):
+    return _arr(shape, seed, lo, hi)
+
+
+def _away_from_zero(shape, seed=0, margin=0.25):
+    x = _rng(seed).uniform(-1, 1, shape).astype(np.float32)
+    x = np.where(np.abs(x) < margin, margin * np.sign(x) + (x == 0) * margin,
+                 x)
+    return nd.array(x)
+
+
+# (test id, f(*inputs) -> NDArray, [inputs])
+CASES = []
+
+
+def case(name, f, inputs):
+    CASES.append(pytest.param(f, inputs, id=name))
+
+
+S = (2, 3)
+
+# -- smooth unary math ------------------------------------------------------
+for opname in ["sigmoid", "tanh", "exp", "square", "negative", "erf",
+               "softsign", "sin", "cos", "arctan", "sinh", "cosh",
+               "arcsinh", "expm1"]:
+    case(opname, (lambda op: lambda x: getattr(nd, op)(x))(opname),
+         [_arr(S, seed=hash(opname) % 100)])
+
+for opname in ["log", "sqrt", "rsqrt", "cbrt", "reciprocal", "log1p",
+               "log2", "log10", "gammaln"]:
+    case(opname, (lambda op: lambda x: getattr(nd, op)(x))(opname),
+         [_pos(S, seed=hash(opname) % 100)])
+
+case("abs", lambda x: nd.abs(x), [_away_from_zero(S, 3)])
+case("relu", lambda x: nd.relu(x), [_away_from_zero(S, 4)])
+case("arcsin", lambda x: nd.arcsin(x), [_arr(S, 5, -0.8, 0.8)])
+case("arccos", lambda x: nd.arccos(x), [_arr(S, 6, -0.8, 0.8)])
+case("arctanh", lambda x: nd.arctanh(x), [_arr(S, 7, -0.8, 0.8)])
+case("arccosh", lambda x: nd.arccosh(x), [_pos(S, 8, 1.5, 3.0)])
+case("tan", lambda x: nd.tan(x), [_arr(S, 9, -0.5, 0.5)])
+case("hard_sigmoid", lambda x: nd.hard_sigmoid(x),
+     [_arr(S, 10, -1.5, 1.5)])
+
+# -- scalar ops -------------------------------------------------------------
+case("plus_scalar", lambda x: x + 1.5, [_arr(S, 11)])
+case("minus_scalar", lambda x: x - 0.5, [_arr(S, 12)])
+case("rminus_scalar", lambda x: 2.0 - x, [_arr(S, 13)])
+case("mul_scalar", lambda x: x * 3.0, [_arr(S, 14)])
+case("div_scalar", lambda x: x / 2.0, [_arr(S, 15)])
+case("rdiv_scalar", lambda x: 2.0 / x, [_pos(S, 16)])
+case("pow_scalar", lambda x: x ** 3.0, [_pos(S, 17)])
+
+# -- binary / broadcast -----------------------------------------------------
+case("elemwise_add", lambda a, b: a + b, [_arr(S, 20), _arr(S, 21)])
+case("elemwise_sub", lambda a, b: a - b, [_arr(S, 22), _arr(S, 23)])
+case("elemwise_mul", lambda a, b: a * b, [_arr(S, 24), _arr(S, 25)])
+case("elemwise_div", lambda a, b: a / b, [_arr(S, 26), _pos(S, 27)])
+case("broadcast_add", lambda a, b: nd.broadcast_add(a, b),
+     [_arr((2, 3), 28), _arr((1, 3), 29)])
+case("broadcast_mul", lambda a, b: nd.broadcast_mul(a, b),
+     [_arr((2, 3), 30), _arr((2, 1), 31)])
+case("broadcast_div", lambda a, b: nd.broadcast_div(a, b),
+     [_arr((2, 3), 32), _pos((1, 3), 33)])
+case("broadcast_power", lambda a, b: nd.broadcast_power(a, b),
+     [_pos((2, 3), 34), _arr((1, 3), 35)])
+case("maximum", lambda a, b: nd.broadcast_maximum(a, b),
+     [_arr(S, 36, -1, 0), _arr(S, 37, 0.1, 1)])
+case("minimum", lambda a, b: nd.broadcast_minimum(a, b),
+     [_arr(S, 38, -1, 0), _arr(S, 39, 0.1, 1)])
+case("hypot", lambda a, b: nd.broadcast_hypot(a, b), [_pos(S, 40), _pos(S, 41)])
+
+# -- reductions -------------------------------------------------------------
+case("sum", lambda x: nd.sum(x), [_arr(S, 50)])
+case("sum_axis", lambda x: nd.sum(x, axis=1), [_arr(S, 51)])
+case("mean", lambda x: nd.mean(x, axis=0), [_arr(S, 52)])
+case("prod", lambda x: nd.prod(x, axis=1), [_pos(S, 53)])
+case("nansum", lambda x: nd.nansum(x, axis=0), [_arr(S, 54)])
+case("norm", lambda x: nd.norm(x), [_pos(S, 55)])
+case("max_reduce", lambda x: nd.max(x, axis=1),
+     [nd.array(np.array([[1., 5., 2.], [7., 3., 4.]], np.float32))])
+case("min_reduce", lambda x: nd.min(x, axis=1),
+     [nd.array(np.array([[1., 5., 2.], [7., 3., 4.]], np.float32))])
+case("square_sum", lambda x: nd.square_sum(x, axis=1), [_arr(S, 56)])
+case("sum_keepdims", lambda x: nd.sum(x, axis=1, keepdims=True),
+     [_arr(S, 57)])
+
+# -- shape / indexing -------------------------------------------------------
+case("reshape", lambda x: nd.reshape(x, shape=(3, 2)), [_arr(S, 60)])
+case("transpose", lambda x: nd.transpose(x, axes=(1, 0)), [_arr(S, 61)])
+case("swapaxes", lambda x: nd.swapaxes(x, dim1=0, dim2=1), [_arr(S, 62)])
+case("expand_dims", lambda x: nd.expand_dims(x, axis=1), [_arr(S, 63)])
+case("flatten", lambda x: nd.Flatten(x), [_arr((2, 3, 2), 64)])
+case("flip", lambda x: nd.flip(x, axis=1), [_arr(S, 65)])
+case("tile", lambda x: nd.tile(x, reps=(2, 1)), [_arr(S, 66)])
+case("repeat", lambda x: nd.repeat(x, repeats=2, axis=0), [_arr(S, 67)])
+case("clip", lambda x: nd.clip(x, a_min=-0.6, a_max=0.6), [_arr(S, 68, -0.5, 0.5)])
+case("slice", lambda x: nd.slice(x, begin=(0, 1), end=(2, 3)),
+     [_arr(S, 69)])
+case("slice_axis", lambda x: nd.slice_axis(x, axis=1, begin=0, end=2),
+     [_arr(S, 70)])
+case("concat", lambda a, b: nd.concat(a, b, dim=1),
+     [_arr(S, 71), _arr(S, 72)])
+case("stack", lambda a, b: nd.stack(a, b, axis=0),
+     [_arr(S, 73), _arr(S, 74)])
+case("split_sum", lambda x: nd.split(x, num_outputs=3, axis=1)[1],
+     [_arr(S, 75)])
+case("take", lambda x: nd.take(x, nd.array(np.array([0, 1, 0],
+                                                    np.float32))),
+     [_arr(S, 76)])
+case("where", lambda a, b: nd.where(
+    nd.array(np.array([[1, 0, 1], [0, 1, 0]], np.float32)), a, b),
+    [_arr(S, 77), _arr(S, 78)])
+case("pad", lambda x: nd.Pad(x, mode="constant",
+                             pad_width=(0, 0, 0, 0, 1, 1, 1, 1)),
+     [_arr((1, 1, 2, 3), 79)])
+case("reverse", lambda x: nd.reverse(x, axis=1), [_arr(S, 80)])
+case("cast64", lambda x: nd.cast(x, dtype="float64"), [_arr(S, 81)])
+
+# -- linear algebra ---------------------------------------------------------
+case("dot", lambda a, b: nd.dot(a, b), [_arr((2, 3), 90), _arr((3, 2), 91)])
+case("dot_ta", lambda a, b: nd.dot(a, b, transpose_a=True),
+     [_arr((3, 2), 92), _arr((3, 2), 93)])
+case("batch_dot", lambda a, b: nd.batch_dot(a, b),
+     [_arr((2, 2, 3), 94), _arr((2, 3, 2), 95)])
+case("linalg_gemm2", lambda a, b: nd.linalg.gemm2(a, b),
+     [_arr((2, 3), 96), _arr((3, 2), 97)])
+case("linalg_syrk", lambda a: nd.linalg.syrk(a), [_arr((3, 3), 98)])
+
+# -- neural network ---------------------------------------------------------
+case("FullyConnected",
+     lambda x, w, b: nd.FullyConnected(x, w, b, num_hidden=4),
+     [_arr((2, 3), 100), _arr((4, 3), 101), _arr((4,), 102)])
+case("Convolution",
+     lambda x, w: nd.Convolution(x, w, kernel=(2, 2), num_filter=2,
+                                 no_bias=True),
+     [_arr((1, 2, 4, 4), 103), _arr((2, 2, 2, 2), 104)])
+case("Deconvolution",
+     lambda x, w: nd.Deconvolution(x, w, kernel=(2, 2), num_filter=2,
+                                   no_bias=True),
+     [_arr((1, 2, 3, 3), 105), _arr((2, 2, 2, 2), 106)])
+case("Pooling_avg",
+     lambda x: nd.Pooling(x, kernel=(2, 2), pool_type="avg", stride=(2, 2)),
+     [_arr((1, 1, 4, 4), 107)])
+case("Pooling_max",
+     lambda x: nd.Pooling(x, kernel=(2, 2), pool_type="max", stride=(2, 2)),
+     [nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))])
+case("Activation_tanh",
+     lambda x: nd.Activation(x, act_type="tanh"), [_arr(S, 108)])
+case("Activation_softrelu",
+     lambda x: nd.Activation(x, act_type="softrelu"), [_arr(S, 109)])
+case("LeakyReLU",
+     lambda x: nd.LeakyReLU(x, act_type="leaky", slope=0.1),
+     [_away_from_zero(S, 110)])
+case("softmax", lambda x: nd.softmax(x), [_arr(S, 111)])
+case("log_softmax", lambda x: nd.log_softmax(x), [_arr(S, 112)])
+case("LayerNorm",
+     lambda x, g, b: nd.LayerNorm(x, g, b),
+     [_arr(S, 113), _pos((3,), 114), _arr((3,), 115)])
+case("LRN", lambda x: nd.LRN(x, nsize=3), [_arr((1, 4, 2, 2), 116)])
+case("BilinearSampler",
+     lambda x, g: nd.BilinearSampler(x, g),
+     [_arr((1, 1, 4, 4), 117), _arr((1, 2, 3, 3), 118, -0.7, 0.7)])
+case("Embedding_data_grad",
+     lambda w: nd.Embedding(nd.array(np.array([1, 0, 2], np.float32)), w,
+                            input_dim=4, output_dim=3),
+     [_arr((4, 3), 119)])
+case("SequenceMask",
+     lambda x: nd.SequenceMask(x, nd.array(np.array([1, 2], np.float32)),
+                               use_sequence_length=True),
+     [_arr((3, 2, 2), 120)])
+case("UpSampling",
+     lambda x: nd.UpSampling(x, scale=2, sample_type="nearest"),
+     [_arr((1, 1, 2, 2), 121)])
+case("flash_attention",
+     lambda q, k, v: nd.flash_attention(q, k, v),
+     [_arr((1, 1, 4, 4), 122), _arr((1, 1, 4, 4), 123),
+      _arr((1, 1, 4, 4), 124)])
+case("ROIPooling",
+     lambda x: nd.ROIPooling(
+         x, nd.array(np.array([[0, 0, 0, 3, 3]], np.float32)),
+         pooled_size=(2, 2), spatial_scale=1.0),
+     [_arr((1, 1, 4, 4), 125, 0.5, 2.0)])
+case("ctc_loss",
+     lambda x: nd.contrib.CTCLoss(
+         x, nd.array(np.array([[1, 2], [1, 1]], np.float32))),
+     [_arr((4, 2, 4), 126)])
+
+
+@pytest.mark.parametrize("f,inputs", CASES)
+def test_numeric_gradient(f, inputs):
+    check_numeric_gradient(f, inputs)
+
+
+def test_sweep_covers_many_ops():
+    assert len(CASES) >= 95
