@@ -56,6 +56,7 @@ from . import parallel
 from . import contrib
 from . import operator
 from . import rnn
+from . import executor_manager
 from . import profiler
 from . import config
 from . import visualization

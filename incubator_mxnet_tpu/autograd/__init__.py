@@ -224,14 +224,20 @@ def _recorded_vjp(node, ct_nds):
 
     n_in = len(node.inputs)
     if node.fn is None:
-        # no replayable function → the second derivative cannot exist;
-        # refuse loudly instead of returning silently-disconnected grads
-        raise RuntimeError(
-            "create_graph=True cannot differentiate through %r: its "
-            "backward is an opaque callback with no replayable function "
-            "(autograd.Function). Express it with regular ops or a "
-            "custom op (mx.operator) to get higher-order gradients."
-            % node.op.name)
+        # no replayable function: first-order cotangents flow, but they
+        # cannot be differentiated again — warn now, and raise only if
+        # someone actually backprops through them (the tape-less NDArrays
+        # below act as constants; _run_backward never revisits them)
+        import warnings
+        warnings.warn(
+            "create_graph=True through %r: its backward is an opaque "
+            "callback (autograd.Function), so gradients flowing through "
+            "it are first-order only — a second backward treats them as "
+            "constants. Use regular ops or mx.operator custom ops for "
+            "true higher-order support." % node.op.name, stacklevel=3)
+        raw = node.vjp(tuple(c._read() for c in ct_nds)
+                       if len(ct_nds) > 1 else ct_nds[0]._read())
+        return tuple(NDArray(g) if g is not None else None for g in raw)
 
     def gfun(*args):
         prim = args[:n_in]

@@ -3,3 +3,5 @@
 Parity: python/mxnet/contrib/__init__.py (quantization, onnx, text, ...).
 """
 from . import quantization  # noqa: F401
+from . import onnx  # noqa: F401
+from . import text  # noqa: F401

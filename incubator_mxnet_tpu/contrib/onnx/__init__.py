@@ -1,0 +1,10 @@
+"""ONNX import (ref: python/mxnet/contrib/onnx/__init__.py).
+
+``import_model(path)`` → (Symbol, arg_params, aux_params).  The
+op-translation layer is self-contained; only deserializing ``.onnx``
+protobuf files needs the ``onnx`` package (same dependency contract as
+the reference importer).
+"""
+from .import_model import import_model
+from .import_onnx import GraphProto
+from . import op_translations

@@ -1,0 +1,23 @@
+"""User-level ONNX entry point
+(ref: python/mxnet/contrib/onnx/_import/import_model.py).
+"""
+from __future__ import annotations
+
+from .import_onnx import GraphProto
+
+__all__ = ["import_model"]
+
+
+def import_model(model_file):
+    """Load an .onnx file → (sym, arg_params, aux_params)
+    (ref: import_model.py import_model).  Requires the ``onnx`` package
+    for protobuf deserialization, like the reference importer."""
+    try:
+        import onnx
+    except ImportError:
+        raise ImportError("Onnx and protobuf need to be installed. "
+                          "Instructions to install - "
+                          "https://github.com/onnx/onnx#installation")
+    model_proto = onnx.load(model_file)
+    graph = GraphProto()
+    return graph.from_onnx(model_proto.graph)

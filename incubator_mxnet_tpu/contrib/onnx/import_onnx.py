@@ -1,0 +1,129 @@
+"""ONNX GraphProto → Symbol graph
+(ref: python/mxnet/contrib/onnx/_import/import_onnx.py GraphProto:27).
+
+``from_onnx`` consumes anything shaped like an ONNX graph: the real
+``onnx.GraphProto`` or any object exposing ``node`` / ``input`` /
+``initializer`` with the same fields — so the translation layer tests
+without the onnx package installed.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import ndarray as nd
+from ... import symbol as sym
+from ...base import MXNetError
+from .op_translations import get_convert_map
+
+__all__ = ["GraphProto"]
+
+
+class GraphProto(object):
+    """Stateful translator for one ONNX graph (ref: import_onnx.py:27)."""
+
+    def __init__(self):
+        self._nodes = {}       # onnx value name -> Symbol
+        self._params = {}      # initializer name -> NDArray
+        self._consts = {}      # value name -> numpy constant
+        self.arg_dict = {}
+        self.aux_dict = {}
+
+    # hooks used by op translators -----------------------------------------
+    def weight_shape(self, weight_sym):
+        name = weight_sym.name
+        if name in self._params:
+            return tuple(self._params[name].shape)
+        raise MXNetError("translator needs the shape of initializer %r"
+                         % name)
+
+    def constant_value(self, value_sym):
+        name = value_sym.name
+        if name in self._consts:
+            return self._consts[name]
+        if name in self._params:
+            return self._params[name].asnumpy()
+        raise MXNetError("%r is not a known constant" % name)
+
+    def make_constant(self, array):
+        """Constant node → a variable pre-filled through arg_dict."""
+        name = "constant%d" % len(self._consts)
+        self._consts[name] = np.asarray(array)
+        self._params[name] = nd.array(np.asarray(array))
+        return sym.var(name)
+
+    # main entry ------------------------------------------------------------
+    def from_onnx(self, graph):
+        """Translate a graph (ref: import_onnx.py from_onnx:73).
+        Returns (Symbol, arg_params, aux_params)."""
+        convert_map = get_convert_map()
+        for init in graph.initializer:
+            self._params[init.name] = nd.array(self._parse_array(init))
+        for inp in graph.input:
+            name = inp if isinstance(inp, str) else inp.name
+            if name not in self._params:
+                self._nodes[name] = sym.var(name)
+            else:
+                self._nodes[name] = sym.var(name)
+        for node in graph.node:
+            op_type = node.op_type
+            if op_type not in convert_map:
+                raise MXNetError(
+                    "ONNX op %r is not supported by the importer (have: %s)"
+                    % (op_type, sorted(convert_map)))
+            attrs = self._parse_attr(getattr(node, "attribute", []))
+            inputs = [self._nodes[i] for i in node.input if i]
+            out = convert_map[op_type](attrs, inputs, self)
+            outputs = list(node.output)
+            if len(outputs) == 1:
+                self._nodes[outputs[0]] = out
+            else:
+                for i, oname in enumerate(outputs):
+                    try:
+                        self._nodes[oname] = out[i]
+                    except (IndexError, TypeError):
+                        break     # trailing optional outputs (e.g. BN stats)
+        out_syms = [self._nodes[o if isinstance(o, str) else o.name]
+                    for o in graph.output]
+        final = out_syms[0] if len(out_syms) == 1 else sym.Group(out_syms)
+        arg_names = set(final.list_arguments())
+        aux_names = set(final.list_auxiliary_states())
+        self.arg_dict = {k: v for k, v in self._params.items()
+                         if k in arg_names}
+        self.aux_dict = {k: v for k, v in self._params.items()
+                         if k in aux_names}
+        return final, self.arg_dict, self.aux_dict
+
+    @staticmethod
+    def _parse_array(tensor_proto):
+        """TensorProto → numpy (ref: import_onnx.py _parse_array:146)."""
+        if hasattr(tensor_proto, "asnumpy"):
+            return tensor_proto.asnumpy()
+        if isinstance(tensor_proto, np.ndarray):
+            return tensor_proto
+        try:
+            from onnx import numpy_helper
+            return numpy_helper.to_array(tensor_proto)
+        except ImportError:
+            # duck-typed initializer used by tests: .array attribute
+            if hasattr(tensor_proto, "array"):
+                return np.asarray(tensor_proto.array)
+            raise
+
+    @staticmethod
+    def _parse_attr(attr_protos):
+        """AttributeProto list (or a plain dict) → python dict
+        (ref: import_onnx.py _parse_attr:155)."""
+        if isinstance(attr_protos, dict):
+            return dict(attr_protos)
+        attrs = {}
+        for a in attr_protos:
+            for field in ("f", "i", "s"):
+                if a.HasField(field):
+                    v = getattr(a, field)
+                    attrs[a.name] = v.decode() if isinstance(v, bytes) else v
+            for field in ("floats", "ints", "strings"):
+                if list(getattr(a, field)):
+                    attrs[a.name] = tuple(getattr(a, field))
+            if a.HasField("t"):
+                attrs[a.name] = GraphProto._parse_array(a.t)
+        return attrs

@@ -1,0 +1,308 @@
+"""ONNX operator → Symbol translations
+(ref: python/mxnet/contrib/onnx/_import/op_translations.py).
+
+Each translator: ``f(attrs: dict, inputs: list[Symbol], proto_obj) ->
+Symbol``.  Covers the opset-7-era surface the reference supports for
+the common CNN/MLP model families.
+"""
+from __future__ import annotations
+
+from ... import symbol as sym
+from ...base import MXNetError
+
+_CONVERT = {}
+
+
+def register(op_name):
+    def dec(f):
+        _CONVERT[op_name] = f
+        return f
+    return dec
+
+
+def get_convert_map():
+    return dict(_CONVERT)
+
+
+def _pad_pair(pads):
+    """ONNX [x1b, x2b, x1e, x2e] → symmetric (x1, x2); MXNet convs take
+    one pad per axis."""
+    if not pads:
+        return None
+    n = len(pads) // 2
+    begin, end = pads[:n], pads[n:]
+    if tuple(begin) != tuple(end):
+        raise MXNetError("asymmetric ONNX pads %s not expressible as "
+                         "Convolution pad; insert an explicit Pad node"
+                         % (pads,))
+    return tuple(begin)
+
+
+@register("Conv")
+def _conv(attrs, inputs, proto):
+    pad = _pad_pair(attrs.get("pads"))
+    kwargs = {"kernel": tuple(attrs["kernel_shape"]),
+              "num_filter": proto.weight_shape(inputs[1])[0],
+              "num_group": attrs.get("group", 1),
+              "no_bias": len(inputs) < 3}
+    if attrs.get("strides"):
+        kwargs["stride"] = tuple(attrs["strides"])
+    if attrs.get("dilations"):
+        kwargs["dilate"] = tuple(attrs["dilations"])
+    if pad:
+        kwargs["pad"] = pad
+    return sym.Convolution(*inputs, **kwargs)
+
+
+@register("Gemm")
+def _gemm(attrs, inputs, proto):
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    trans_b = attrs.get("transB", 0)
+    a, b = inputs[0], inputs[1]
+    if attrs.get("transA", 0):
+        a = sym.transpose(a, axes=(1, 0))
+    if not trans_b:
+        b = sym.transpose(b, axes=(1, 0))
+    num_hidden = proto.weight_shape(inputs[1])[0 if trans_b else 1]
+    args = [a, b]
+    if len(inputs) > 2:
+        bias = inputs[2] if beta == 1.0 else inputs[2] * beta
+        args.append(bias)
+    out = sym.FullyConnected(*args, num_hidden=num_hidden,
+                             no_bias=len(inputs) < 3)
+    if alpha != 1.0:
+        out = out * alpha
+    return out
+
+
+@register("MatMul")
+def _matmul(attrs, inputs, proto):
+    return sym.dot(inputs[0], inputs[1])
+
+
+@register("BatchNormalization")
+def _batchnorm(attrs, inputs, proto):
+    return sym.BatchNorm(*inputs,
+                         eps=attrs.get("epsilon", 1e-5),
+                         momentum=attrs.get("momentum", 0.9),
+                         fix_gamma=False, use_global_stats=True)
+
+
+@register("Relu")
+def _relu(attrs, inputs, proto):
+    return sym.Activation(inputs[0], act_type="relu")
+
+
+@register("Sigmoid")
+def _sigmoid(attrs, inputs, proto):
+    return sym.Activation(inputs[0], act_type="sigmoid")
+
+
+@register("Tanh")
+def _tanh(attrs, inputs, proto):
+    return sym.Activation(inputs[0], act_type="tanh")
+
+
+@register("LeakyRelu")
+def _leaky(attrs, inputs, proto):
+    return sym.LeakyReLU(inputs[0], act_type="leaky",
+                         slope=attrs.get("alpha", 0.01))
+
+
+@register("Elu")
+def _elu(attrs, inputs, proto):
+    return sym.LeakyReLU(inputs[0], act_type="elu",
+                         slope=attrs.get("alpha", 1.0))
+
+
+@register("Softmax")
+def _softmax(attrs, inputs, proto):
+    return sym.softmax(inputs[0], axis=attrs.get("axis", 1))
+
+
+@register("MaxPool")
+def _maxpool(attrs, inputs, proto):
+    return _pool(attrs, inputs, "max")
+
+
+@register("AveragePool")
+def _avgpool(attrs, inputs, proto):
+    return _pool(attrs, inputs, "avg")
+
+
+def _pool(attrs, inputs, kind):
+    kwargs = {"kernel": tuple(attrs["kernel_shape"]), "pool_type": kind}
+    if attrs.get("strides"):
+        kwargs["stride"] = tuple(attrs["strides"])
+    pad = _pad_pair(attrs.get("pads"))
+    if pad:
+        kwargs["pad"] = pad
+    if kind == "avg":
+        kwargs["count_include_pad"] = bool(attrs.get("count_include_pad", 0))
+    return sym.Pooling(inputs[0], **kwargs)
+
+
+@register("GlobalAveragePool")
+def _gap(attrs, inputs, proto):
+    return sym.Pooling(inputs[0], global_pool=True, pool_type="avg")
+
+
+@register("GlobalMaxPool")
+def _gmp(attrs, inputs, proto):
+    return sym.Pooling(inputs[0], global_pool=True, pool_type="max")
+
+
+@register("Add")
+def _add(attrs, inputs, proto):
+    return sym.broadcast_add(inputs[0], inputs[1])
+
+
+@register("Sub")
+def _sub(attrs, inputs, proto):
+    return sym.broadcast_sub(inputs[0], inputs[1])
+
+
+@register("Mul")
+def _mul(attrs, inputs, proto):
+    return sym.broadcast_mul(inputs[0], inputs[1])
+
+
+@register("Div")
+def _div(attrs, inputs, proto):
+    return sym.broadcast_div(inputs[0], inputs[1])
+
+
+@register("Sum")
+def _sum(attrs, inputs, proto):
+    out = inputs[0]
+    for i in inputs[1:]:
+        out = sym.broadcast_add(out, i)
+    return out
+
+
+@register("Concat")
+def _concat(attrs, inputs, proto):
+    return sym.concat(*inputs, dim=attrs.get("axis", 1))
+
+
+@register("Flatten")
+def _flatten(attrs, inputs, proto):
+    if attrs.get("axis", 1) != 1:
+        raise MXNetError("Flatten axis != 1 is not supported")
+    return sym.Flatten(inputs[0])
+
+
+@register("Reshape")
+def _reshape(attrs, inputs, proto):
+    if "shape" in attrs:              # opset-1 style attribute
+        shape = tuple(attrs["shape"])
+    else:                             # opset-5 style second input
+        shape = tuple(int(v) for v in proto.constant_value(inputs[1]))
+    return sym.reshape(inputs[0], shape=shape)
+
+
+@register("Transpose")
+def _transpose(attrs, inputs, proto):
+    if attrs.get("perm") is not None:
+        return sym.transpose(inputs[0], axes=tuple(attrs["perm"]))
+    return sym.transpose(inputs[0])
+
+
+@register("Dropout")
+def _dropout(attrs, inputs, proto):
+    return sym.Dropout(inputs[0], p=attrs.get("ratio", 0.5))
+
+
+@register("Identity")
+def _identity(attrs, inputs, proto):
+    return inputs[0]
+
+
+@register("Clip")
+def _clip(attrs, inputs, proto):
+    return sym.clip(inputs[0], a_min=attrs.get("min", -3.4e38),
+                    a_max=attrs.get("max", 3.4e38))
+
+
+@register("Pad")
+def _pad_op(attrs, inputs, proto):
+    pads = attrs["pads"]
+    n = len(pads) // 2
+    width = []
+    for i in range(n):
+        width += [pads[i], pads[n + i]]
+    return sym.Pad(inputs[0], mode=attrs.get("mode", "constant"),
+                   pad_width=tuple(width),
+                   constant_value=attrs.get("value", 0.0))
+
+
+@register("Constant")
+def _constant(attrs, inputs, proto):
+    return proto.make_constant(attrs["value"])
+
+
+@register("Exp")
+def _exp(attrs, inputs, proto):
+    return sym.exp(inputs[0])
+
+
+@register("Log")
+def _log(attrs, inputs, proto):
+    return sym.log(inputs[0])
+
+
+@register("Sqrt")
+def _sqrt(attrs, inputs, proto):
+    return sym.sqrt(inputs[0])
+
+
+@register("Neg")
+def _neg(attrs, inputs, proto):
+    return sym.negative(inputs[0])
+
+
+@register("Abs")
+def _abs(attrs, inputs, proto):
+    return sym.abs(inputs[0])
+
+
+@register("Pow")
+def _pow(attrs, inputs, proto):
+    return sym.broadcast_power(inputs[0], inputs[1])
+
+
+@register("ReduceMean")
+def _reduce_mean(attrs, inputs, proto):
+    return sym.mean(inputs[0], axis=tuple(attrs.get("axes", ())) or None,
+                    keepdims=bool(attrs.get("keepdims", 1)))
+
+
+@register("ReduceSum")
+def _reduce_sum(attrs, inputs, proto):
+    return sym.sum(inputs[0], axis=tuple(attrs.get("axes", ())) or None,
+                   keepdims=bool(attrs.get("keepdims", 1)))
+
+
+@register("Squeeze")
+def _squeeze(attrs, inputs, proto):
+    out = inputs[0]
+    for ax in sorted(attrs.get("axes", ()), reverse=True):
+        out = sym.squeeze(out, axis=ax)
+    return out
+
+
+@register("Unsqueeze")
+def _unsqueeze(attrs, inputs, proto):
+    out = inputs[0]
+    for ax in sorted(attrs.get("axes", ())):
+        out = sym.expand_dims(out, axis=ax)
+    return out
+
+
+@register("LRN")
+def _lrn(attrs, inputs, proto):
+    return sym.LRN(inputs[0], nsize=attrs.get("size", 5),
+                   alpha=attrs.get("alpha", 1e-4),
+                   beta=attrs.get("beta", 0.75),
+                   knorm=attrs.get("bias", 1.0))

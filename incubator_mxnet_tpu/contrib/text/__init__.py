@@ -1,0 +1,7 @@
+"""Text utilities: vocabulary + token embeddings
+(ref: python/mxnet/contrib/text/__init__.py).
+"""
+from . import utils
+from . import vocab
+from . import embedding
+from .vocab import Vocabulary

@@ -1,0 +1,191 @@
+"""Token embeddings (ref: python/mxnet/contrib/text/embedding.py).
+
+``_TokenEmbedding`` extends Vocabulary with an (n_tokens, dim) vector
+table; ``CustomEmbedding`` loads word2vec/GloVe-style text files.  The
+reference's GloVe/FastText classes download pretrained archives — no
+egress here, so they resolve strictly from ``MXTPU_HOME`` caches
+(same file formats).
+"""
+from __future__ import annotations
+
+import io
+import logging
+import os
+
+import numpy as np
+
+from ... import ndarray as nd
+from ... import config as _config
+from .vocab import Vocabulary
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "TokenEmbedding", "CustomEmbedding", "GloVe", "FastText"]
+
+_REG = {}
+
+
+def register(cls):
+    """ref: embedding.py register."""
+    _REG[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(embedding_name, **kwargs):
+    """ref: embedding.py create."""
+    try:
+        return _REG[embedding_name.lower()](**kwargs)
+    except KeyError:
+        raise KeyError("unknown embedding %r (have %s)"
+                       % (embedding_name, sorted(_REG)))
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """ref: embedding.py get_pretrained_file_names — known archive names."""
+    table = {
+        "glove": ["glove.42B.300d.txt", "glove.6B.50d.txt",
+                  "glove.6B.100d.txt", "glove.6B.200d.txt",
+                  "glove.6B.300d.txt", "glove.840B.300d.txt"],
+        "fasttext": ["wiki.simple.vec", "wiki.en.vec"],
+    }
+    if embedding_name is None:
+        return table
+    return table[embedding_name.lower()]
+
+
+class TokenEmbedding(Vocabulary):
+    """Vocabulary + vector table (ref: embedding.py _TokenEmbedding:132)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def _load_embedding(self, path, elem_delim=" ",
+                        init_unknown_vec=None):
+        """Parse a word2vec/GloVe text table (ref: embedding.py
+        _load_embedding)."""
+        if not os.path.isfile(path):
+            raise IOError("embedding file %s not found (no egress: place "
+                          "pretrained files under %s)"
+                          % (path, _config.data_home()))
+        tokens, vectors = [], []
+        with io.open(path, "r", encoding="utf8") as f:
+            for lineno, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if lineno == 0 and len(parts) == 2 and \
+                        parts[0].isdigit() and parts[1].isdigit():
+                    continue       # word2vec header "count dim"
+                token, elems = parts[0], parts[1:]
+                if len(elems) <= 1:
+                    logging.warning("line %d in %s: token %r with invalid "
+                                    "embedding, skipped", lineno, path, token)
+                    continue
+                if self._vec_len == 0:
+                    self._vec_len = len(elems)
+                elif len(elems) != self._vec_len:
+                    logging.warning("line %d in %s: dim %d != %d, skipped",
+                                    lineno, path, len(elems), self._vec_len)
+                    continue
+                if token in self._token_to_idx:
+                    continue
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                tokens.append(token)
+                vectors.append(np.asarray(elems, np.float32))
+        table = np.zeros((len(self._idx_to_token), self._vec_len),
+                         np.float32)
+        if init_unknown_vec is not None:
+            table[0] = init_unknown_vec(self._vec_len)
+        start = len(self._idx_to_token) - len(vectors)
+        if vectors:
+            table[start:] = np.stack(vectors)
+        self._idx_to_vec = nd.array(table)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """ref: embedding.py get_vecs_by_tokens:365."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        if lower_case_backup:
+            idx = [self._token_to_idx.get(
+                t, self._token_to_idx.get(t.lower(), 0)) for t in toks]
+        else:
+            idx = [self._token_to_idx.get(t, 0) for t in toks]
+        vecs = self._idx_to_vec.asnumpy()[idx]
+        return nd.array(vecs[0] if single else vecs)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """ref: embedding.py update_token_vectors:404."""
+        if isinstance(tokens, str):
+            tokens = [tokens]
+        arr = np.array(self._idx_to_vec.asnumpy())   # writable copy
+        new = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else np.asarray(new_vectors)
+        new = new.reshape(len(tokens), -1)
+        for t, v in zip(tokens, new):
+            if t not in self._token_to_idx:
+                raise ValueError("token %r is not indexed" % t)
+            arr[self._token_to_idx[t]] = v
+        self._idx_to_vec = nd.array(arr)
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Embedding from a user file 'token v1 v2 ...' per line
+    (ref: embedding.py CustomEmbedding:658)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 init_unknown_vec=None, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec or np.zeros)
+        if vocabulary is not None:
+            self._restrict_to(vocabulary)
+
+    def _restrict_to(self, vocabulary):
+        table = np.zeros((len(vocabulary), self._vec_len), np.float32)
+        full = self._idx_to_vec.asnumpy()
+        for i, tok in enumerate(vocabulary.idx_to_token):
+            j = self._token_to_idx.get(tok)
+            if j is not None:
+                table[i] = full[j]
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_vec = nd.array(table)
+
+
+class _CachedPretrained(TokenEmbedding):
+    _dir = ""
+
+    def __init__(self, pretrained_file_name, embedding_root=None, **kwargs):
+        super().__init__(**kwargs)
+        root = embedding_root or os.path.join(_config.data_home(),
+                                              "embeddings", self._dir)
+        self._load_embedding(os.path.join(os.path.expanduser(root),
+                                          pretrained_file_name),
+                             init_unknown_vec=np.zeros)
+
+
+@register
+class GloVe(_CachedPretrained):
+    """ref: embedding.py GloVe:468 (no egress: reads cached files)."""
+    _dir = "glove"
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt", **kwargs):
+        super().__init__(pretrained_file_name, **kwargs)
+
+
+@register
+class FastText(_CachedPretrained):
+    """ref: embedding.py FastText:558 (no egress: reads cached files)."""
+    _dir = "fasttext"
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec", **kwargs):
+        super().__init__(pretrained_file_name, **kwargs)

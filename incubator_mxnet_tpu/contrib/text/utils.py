@@ -1,0 +1,20 @@
+"""Text helpers (ref: python/mxnet/contrib/text/utils.py)."""
+from __future__ import annotations
+
+import collections
+import re
+
+__all__ = ["count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Token frequency Counter from raw text
+    (ref: utils.py count_tokens_from_str)."""
+    source_str = re.sub(r"(%s)+" % seq_delim, token_delim, source_str)
+    if to_lower:
+        source_str = source_str.lower()
+    counter = (collections.Counter() if counter_to_update is None
+               else counter_to_update)
+    counter.update(t for t in source_str.split(token_delim) if t)
+    return counter

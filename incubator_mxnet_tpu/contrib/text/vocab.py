@@ -1,0 +1,79 @@
+"""Indexed vocabulary (ref: python/mxnet/contrib/text/vocab.py:30)."""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary(object):
+    """Token ↔ index mapping built from a frequency counter
+    (ref: vocab.py Vocabulary:30).  Index 0 is the unknown token;
+    ``reserved_tokens`` follow, then tokens by descending frequency."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        reserved_tokens = list(reserved_tokens or [])
+        if len(set(reserved_tokens)) != len(reserved_tokens) or \
+                unknown_token in reserved_tokens:
+            raise ValueError("reserved_tokens must be unique and exclude "
+                             "the unknown token")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = reserved_tokens or None
+        self._idx_to_token = [unknown_token] + reserved_tokens
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        assert isinstance(counter, collections.Counter)
+        budget = None if most_freq_count is None else \
+            most_freq_count - len(self._idx_to_token)
+        for token, freq in sorted(counter.items(),
+                                  key=lambda kv: (-kv[1], kv[0])):
+            if freq < min_freq or (budget is not None and budget <= 0):
+                break
+            if token not in self._token_to_idx:
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                if budget is not None:
+                    budget -= 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """ref: vocab.py to_indices:160."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        """ref: vocab.py to_tokens:186."""
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        out = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError("token index %d out of range" % i)
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out

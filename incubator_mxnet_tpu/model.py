@@ -106,7 +106,7 @@ class FeedForward(object):
         """ref: model.py FeedForward.predict."""
         from .io import NDArrayIter
         if isinstance(X, (np.ndarray, nd.NDArray)):
-            X = NDArrayIter(X, batch_size=self.numpy_batch_size)
+            X = NDArrayIter(X, batch_size=min(self.numpy_batch_size, len(X)))
         if self._module is None:
             self._make_module(X)
             self._module.bind(X.provide_data, X.provide_label,
@@ -114,6 +114,41 @@ class FeedForward(object):
             self._module.set_params(self.arg_params, self.aux_params or {})
         out = self._module.predict(X, num_batch=num_batch, reset=reset)
         return out.asnumpy() if hasattr(out, "asnumpy") else out
+
+    def score(self, X, eval_metric="acc", num_batch=None,
+              batch_end_callback=None, reset=True):
+        """ref: model.py FeedForward.score:725 → Module.score."""
+        from .io import NDArrayIter
+        if isinstance(X, (np.ndarray, nd.NDArray)):
+            raise TypeError("score requires a DataIter with labels")
+        if self._module is None:
+            self._make_module(X)
+            self._module.bind(X.provide_data, X.provide_label,
+                              for_training=False)
+            self._module.set_params(self.arg_params, self.aux_params or {})
+        res = self._module.score(X, eval_metric, num_batch=num_batch,
+                                 batch_end_callback=batch_end_callback,
+                                 reset=reset)
+        return res[0][1] if res else None
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=None, eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        """Train a model in one call (ref: model.py FeedForward.create:932)."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, work_load_list=work_load_list,
+                  eval_end_callback=eval_end_callback,
+                  eval_batch_end_callback=eval_batch_end_callback)
+        return model
 
     def save(self, prefix, epoch=None):
         """ref: model.py FeedForward.save."""

@@ -117,10 +117,14 @@ class Module(BaseModule):
 
     @property
     def output_shapes(self):
+        """Inferred from the bound shapes — valid before any forward
+        (ref: module.py output_shapes via the executor's inferred graph)."""
         assert self.binded
-        outs = self._exec_group.get_outputs()
-        return list(zip(self._output_names, [o.shape for o in outs])) \
-            if outs else []
+        known = {n: tuple(s) for n, s in self._data_shapes}
+        for n, s in (self._label_shapes or []):
+            known[n] = tuple(s)
+        _, out_shapes, _ = self._symbol.infer_shape(**known)
+        return list(zip(self._output_names, out_shapes))
 
     # -- params ------------------------------------------------------------
     def get_params(self):
@@ -337,7 +341,7 @@ class Module(BaseModule):
                                               new_data_shapes)]
             if getattr(data_batch, "provide_label", None):
                 new_lshape = data_batch.provide_label
-            elif getattr(data_batch, "label", None):
+            elif getattr(data_batch, "label", None) and self._label_shapes:
                 new_lshape = [DataDesc(i.name, j.shape, i.dtype, i.layout)
                               for i, j in zip(self._label_shapes,
                                               data_batch.label)]

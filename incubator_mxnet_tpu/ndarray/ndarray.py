@@ -33,12 +33,11 @@ from .. import config as _config
 # read once at import like dmlc::GetEnv's static locals.
 _NAIVE_ENGINE = _config.naive_engine()
 
-# last dispatched output per device for waitall() (WaitForAll): XLA
-# executes in dispatch order per device stream, so blocking on the most
-# recent output of each stream drains it.  Strong refs (one buffer per
-# device) — a collected weakref would only prove the buffer was freed,
-# not that its computation ran.
-_LAST_DISPATCH_PER_DEVICE = {}
+# devices that have received dispatches, for waitall() (WaitForAll):
+# XLA executes compute in dispatch order per device stream, so enqueueing
+# a trivial computation and blocking on it drains everything before it —
+# a stream barrier, with no output buffers pinned.
+_DISPATCH_DEVICES = set()
 
 __all__ = ["NDArray", "array", "empty", "invoke", "waitall",
            "concatenate", "moveaxis", "imperative_invoke"]
@@ -561,8 +560,7 @@ def invoke(op: Operator, inputs, params, out=None):
     devs = getattr(first, "devices", None)
     if devs is not None:
         try:
-            for d in devs():
-                _LAST_DISPATCH_PER_DEVICE[d] = first
+            _DISPATCH_DEVICES.update(devs())
         except Exception:       # tracers inside jit have no devices
             pass
 
@@ -646,15 +644,17 @@ def waitall():
     """Block until all outstanding work has executed
     (ref: mx.nd.waitall → Engine::WaitForAll, threaded_engine.cc).
 
-    Blocks on the most recently dispatched output of every device stream
-    — in-order execution per stream makes that equivalent to draining
-    the queues."""
-    for arr in list(_LAST_DISPATCH_PER_DEVICE.values()):
+    Enqueues a barrier computation on every device that has seen
+    dispatches and blocks on it — in-order execution per stream makes
+    that equivalent to draining the queues, without pinning any user
+    buffer."""
+    for d in list(_DISPATCH_DEVICES):
         try:
-            jax.block_until_ready(arr)
-        except Exception:           # donated/deleted buffers: already done
+            token = jax.device_put(jnp.zeros((), jnp.float32), d)
+            jax.block_until_ready(jax.jit(lambda t: t + 1)(token))
+        except Exception:           # device gone / backend quirk
             pass
-    _LAST_DISPATCH_PER_DEVICE.clear()
+    _DISPATCH_DEVICES.clear()
 
 
 def concatenate(arrays, axis=0, always_copy=True):

@@ -246,53 +246,45 @@ class DataParallelTrainer(object):
                 donate_argnums=(0, 1, 2) if self._donate else ())
         return self._jit_cache[key]
 
-    def step_multi(self, datas, labels):
-        """Run K chained steps in one launch; ``datas`` (K, batch, ...),
-        ``labels`` (K, batch).  Returns the last step's device loss."""
-        xs = datas._read() if isinstance(datas, NDArray) else jnp.asarray(datas)
-        ys = labels._read() if isinstance(labels, NDArray) else jnp.asarray(labels)
-        if self._params is None:
-            self._gather_params(xs[0])
-        fn = self.compile_multi(xs, ys)
-        repl = NamedSharding(self.mesh, P())
-        batch_sh = NamedSharding(self.mesh, P(None, "dp"))
-        if self._rng_key is None:
-            self._rng_key = jax.device_put(random_state.next_key(), repl)
-        if self._lr_dev is None:
-            self._lr_dev = jax.device_put(jnp.asarray(self._lr, jnp.float32),
-                                          repl)
-        if not (hasattr(xs, "sharding")
-                and xs.sharding.is_equivalent_to(batch_sh, xs.ndim)):
-            xs = jax.device_put(xs, batch_sh)
-        if not (hasattr(ys, "sharding")
-                and ys.sharding.is_equivalent_to(batch_sh, ys.ndim)):
-            ys = jax.device_put(ys, batch_sh)
-        self._params, self._opt_state, self._rng_key, loss_val = fn(
-            self._params, self._opt_state, self._rng_key, xs, ys,
-            self._lr_dev)
-        return loss_val
-
-    def step(self, data, label):
-        """Run one sharded train step; returns the (host) scalar loss."""
+    def _prepare_inputs(self, data, label, batch_spec, multi=False):
+        """Shared dispatch prologue: resolve params (deferred init runs on
+        the raw single-device batch, BEFORE mesh sharding), device-resident
+        rng/lr, batch arrays laid out per ``batch_spec`` (resharding
+        skipped when already placed)."""
         x = data._read() if isinstance(data, NDArray) else jnp.asarray(data)
         y = label._read() if isinstance(label, NDArray) else jnp.asarray(label)
-        fn = self.compile(x, y)
+        if self._params is None:
+            self._gather_params(x[0] if multi else x)
         repl = NamedSharding(self.mesh, P())
-        batch_sh = NamedSharding(self.mesh, P("dp"))
+        batch_sh = NamedSharding(self.mesh, batch_spec)
         if self._rng_key is None:
             self._rng_key = jax.device_put(random_state.next_key(), repl)
         if self._lr_dev is None:
             self._lr_dev = jax.device_put(jnp.asarray(self._lr, jnp.float32),
                                           repl)
-        # reshard x/y only when needed: an array already laid out batch-wise
-        # (e.g. the previous step's input buffer) skips the placement round
-        # trip entirely
         if not (hasattr(x, "sharding")
                 and x.sharding.is_equivalent_to(batch_sh, x.ndim)):
             x = jax.device_put(x, batch_sh)
         if not (hasattr(y, "sharding")
                 and y.sharding.is_equivalent_to(batch_sh, y.ndim)):
             y = jax.device_put(y, batch_sh)
+        return x, y
+
+    def step_multi(self, datas, labels):
+        """Run K chained steps in one launch; ``datas`` (K, batch, ...),
+        ``labels`` (K, batch).  Returns the last step's device loss."""
+        xs, ys = self._prepare_inputs(datas, labels, P(None, "dp"),
+                                      multi=True)
+        fn = self.compile_multi(xs, ys)
+        self._params, self._opt_state, self._rng_key, loss_val = fn(
+            self._params, self._opt_state, self._rng_key, xs, ys,
+            self._lr_dev)
+        return loss_val
+
+    def step(self, data, label):
+        """Run one sharded train step; returns the device scalar loss."""
+        x, y = self._prepare_inputs(data, label, P("dp"))
+        fn = self.compile(x, y)
         self._params, self._opt_state, self._rng_key, loss_val = fn(
             self._params, self._opt_state, self._rng_key, x, y, self._lr_dev)
         return loss_val

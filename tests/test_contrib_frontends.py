@@ -1,0 +1,223 @@
+"""ONNX importer, contrib.text, SequentialModule/PythonModule/FeedForward.
+
+Parity models: tests/python/unittest/onnx backend tests (translator
+behavior), test_contrib_text.py, test_module.py SequentialModule cases.
+"""
+import collections
+import types
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, sym, io
+from incubator_mxnet_tpu.test_utils import assert_almost_equal
+
+
+# ---------------------------------------------------------------------------
+# ONNX importer (graph translation without the onnx package: duck-typed
+# protos, the layer the reference tests against its backend suite)
+# ---------------------------------------------------------------------------
+
+def _node(op_type, inputs, outputs, **attrs):
+    return types.SimpleNamespace(op_type=op_type, input=list(inputs),
+                                 output=list(outputs), attribute=attrs)
+
+
+def _init(name, array):
+    return types.SimpleNamespace(name=name,
+                                 array=np.asarray(array, np.float32))
+
+
+def _graph(nodes, inputs, outputs, initializers):
+    return types.SimpleNamespace(node=nodes, input=inputs, output=outputs,
+                                 initializer=initializers)
+
+
+def test_onnx_import_mlp():
+    from incubator_mxnet_tpu.contrib.onnx import GraphProto
+    rng = np.random.RandomState(0)
+    w1 = rng.randn(4, 3).astype(np.float32)
+    b1 = rng.randn(4).astype(np.float32)
+    graph = _graph(
+        nodes=[_node("Gemm", ["x", "w1", "b1"], ["h"], transB=1),
+               _node("Relu", ["h"], ["a"]),
+               _node("Softmax", ["a"], ["y"])],
+        inputs=["x", "w1", "b1"],
+        outputs=["y"],
+        initializers=[_init("w1", w1), _init("b1", b1)])
+    s, arg_params, aux_params = GraphProto().from_onnx(graph)
+    assert set(arg_params) == {"w1", "b1"}
+    x = rng.randn(2, 3).astype(np.float32)
+    args = dict(arg_params)
+    args["x"] = nd.array(x)
+    out = s.bind(mx.cpu(), args, grad_req="null") \
+           .forward(is_train=False)[0].asnumpy()
+    h = np.maximum(x @ w1.T + b1, 0)
+    e = np.exp(h - h.max(1, keepdims=True))
+    ref = e / e.sum(1, keepdims=True)
+    assert_almost_equal(out, ref, rtol=1e-5)
+
+
+def test_onnx_import_conv_pool_bn():
+    from incubator_mxnet_tpu.contrib.onnx import GraphProto
+    rng = np.random.RandomState(1)
+    w = rng.randn(4, 2, 3, 3).astype(np.float32)
+    gamma = rng.rand(4).astype(np.float32) + 0.5
+    beta = rng.randn(4).astype(np.float32)
+    mean = rng.randn(4).astype(np.float32)
+    var = rng.rand(4).astype(np.float32) + 0.5
+    graph = _graph(
+        nodes=[_node("Conv", ["x", "w"], ["c"], kernel_shape=(3, 3),
+                     pads=(1, 1, 1, 1)),
+               _node("BatchNormalization",
+                     ["c", "gamma", "beta", "mean", "var"], ["bn"],
+                     epsilon=1e-5),
+               _node("MaxPool", ["bn"], ["p"], kernel_shape=(2, 2),
+                     strides=(2, 2)),
+               _node("Flatten", ["p"], ["f"]),
+               _node("GlobalAveragePool", ["c"], ["g"])],
+        inputs=["x", "w", "gamma", "beta", "mean", "var"],
+        outputs=["f"],
+        initializers=[_init("w", w), _init("gamma", gamma),
+                      _init("beta", beta), _init("mean", mean),
+                      _init("var", var)])
+    s, arg_params, aux_params = GraphProto().from_onnx(graph)
+    x = rng.randn(1, 2, 8, 8).astype(np.float32)
+    args = dict(arg_params)
+    args["x"] = nd.array(x)
+    exe = s.bind(mx.cpu(), args, grad_req="null", aux_states=aux_params)
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (1, 4 * 4 * 4)
+    # reference: conv -> BN(global stats) -> maxpool -> flatten
+    ref_c = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                           num_filter=4, pad=(1, 1), no_bias=True).asnumpy()
+    ref_bn = (ref_c - mean.reshape(1, -1, 1, 1)) / \
+        np.sqrt(var.reshape(1, -1, 1, 1) + 1e-5) * \
+        gamma.reshape(1, -1, 1, 1) + beta.reshape(1, -1, 1, 1)
+    ref_p = ref_bn.reshape(1, 4, 4, 2, 4, 2).max(axis=(3, 5))
+    assert_almost_equal(out, ref_p.reshape(1, -1), rtol=1e-4, atol=1e-4)
+
+
+def test_onnx_unsupported_op_errors():
+    from incubator_mxnet_tpu.contrib.onnx import GraphProto
+    graph = _graph(nodes=[_node("NotAnOp", ["x"], ["y"])],
+                   inputs=["x"], outputs=["y"], initializers=[])
+    with pytest.raises(mx.MXNetError):
+        GraphProto().from_onnx(graph)
+
+
+def test_onnx_import_model_needs_onnx_package():
+    from incubator_mxnet_tpu.contrib.onnx import import_model
+    with pytest.raises(ImportError):
+        import_model("/nonexistent/model.onnx")
+
+
+# ---------------------------------------------------------------------------
+# contrib.text
+# ---------------------------------------------------------------------------
+
+def test_text_vocabulary():
+    from incubator_mxnet_tpu.contrib import text
+    counter = text.utils.count_tokens_from_str("a b b c c c\nd d d d")
+    assert counter["c"] == 3 and counter["d"] == 4
+    vocab = text.Vocabulary(counter, most_freq_count=None, min_freq=2,
+                            reserved_tokens=["<pad>"])
+    # <unk>, <pad>, then d, c, b by frequency ("a" dropped: freq 1)
+    assert vocab.idx_to_token[:5] == ["<unk>", "<pad>", "d", "c", "b"]
+    assert vocab.to_indices(["d", "zzz"]) == [2, 0]
+    assert vocab.to_tokens([3, 4]) == ["c", "b"]
+    assert len(vocab) == 5
+
+
+def test_text_custom_embedding(tmp_path):
+    from incubator_mxnet_tpu.contrib import text
+    p = tmp_path / "emb.txt"
+    p.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = text.embedding.CustomEmbedding(str(p))
+    assert emb.vec_len == 3
+    v = emb.get_vecs_by_tokens(["hello", "unknowntok"]).asnumpy()
+    assert_almost_equal(v[0], [1.0, 2.0, 3.0], rtol=1e-6)
+    assert (v[1] == 0).all()
+    emb.update_token_vectors("world", nd.array(np.array([9., 9., 9.],
+                                                        np.float32)))
+    assert_almost_equal(emb.get_vecs_by_tokens("world").asnumpy(),
+                        [9, 9, 9], rtol=1e-6)
+    emb2 = text.embedding.create("customembedding",
+                                 pretrained_file_path=str(p))
+    assert emb2.vec_len == 3
+
+
+# ---------------------------------------------------------------------------
+# SequentialModule / PythonLossModule / FeedForward
+# ---------------------------------------------------------------------------
+
+def _toy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(120, 10).astype(np.float32)
+    w = rng.randn(10, 3).astype(np.float32)
+    y = (x @ w).argmax(1).astype(np.float32)
+    return x, y
+
+
+def test_sequential_module_trains():
+    x, y = _toy()
+    net1 = sym.Activation(sym.FullyConnected(sym.var("data"), num_hidden=16,
+                                             name="fc1"), act_type="relu")
+    net2 = sym.SoftmaxOutput(sym.FullyConnected(sym.var("data"),
+                                                num_hidden=3, name="fc2"),
+                             name="softmax")
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net1, label_names=None)) \
+       .add(mx.mod.Module(net2), take_labels=True, auto_wiring=True)
+    it = io.NDArrayIter(x, y, batch_size=20, shuffle=True)
+    seq.fit(it, num_epoch=12, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier())
+    acc = seq.score(io.NDArrayIter(x, y, batch_size=20), "acc")[0][1]
+    assert acc > 0.9
+
+
+def test_python_loss_module_trains():
+    x, y = _toy()
+    feat = sym.FullyConnected(sym.var("data"), num_hidden=3, name="fcp")
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(feat, label_names=None)) \
+       .add(mx.mod.PythonLossModule(), take_labels=True)
+    it = io.NDArrayIter(x, y, batch_size=20, shuffle=True)
+    seq.fit(it, num_epoch=15, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2},
+            initializer=mx.init.Xavier())
+    seq.forward(io.DataBatch(data=[nd.array(x)], label=[nd.array(y)]),
+                is_train=False)
+    out = seq.get_outputs()[0].asnumpy()
+    assert (out.argmax(1) == y).mean() > 0.9
+
+
+def test_feedforward_create_and_score():
+    import warnings
+    x, y = _toy()
+    net = sym.SoftmaxOutput(sym.FullyConnected(sym.var("data"),
+                                               num_hidden=3, name="fcf"),
+                            name="softmax")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        model = mx.model.FeedForward.create(
+            net, io.NDArrayIter(x, y, batch_size=20), num_epoch=12,
+            optimizer="sgd", initializer=mx.init.Xavier(),
+            learning_rate=0.5)
+        acc = model.score(io.NDArrayIter(x, y, batch_size=20))
+    assert acc > 0.9
+    pred = model.predict(x[:20])
+    assert pred.shape == (20, 3)
+
+
+def test_executor_manager_shim():
+    from incubator_mxnet_tpu.executor_manager import (_split_input_slice,
+                                                      _check_arguments)
+    slices = _split_input_slice(10, [1, 1])
+    assert slices == [slice(0, 5), slice(5, 10)]
+    slices = _split_input_slice(9, [2, 1])
+    assert slices[0] == slice(0, 6) and slices[1] == slice(6, 9)
+    net = sym.FullyConnected(sym.var("data"), num_hidden=2, name="fcx")
+    _check_arguments(net)   # no duplicates → passes

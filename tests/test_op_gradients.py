@@ -5,6 +5,8 @@ across test_operator.py (python/mxnet/test_utils.py:792; 5,439-LoC op
 suite).  One parameterized test per op entry: analytic tape gradients
 vs central finite differences on smooth-input samples.
 """
+import zlib
+
 import numpy as np
 import pytest
 
@@ -47,12 +49,12 @@ for opname in ["sigmoid", "tanh", "exp", "square", "negative", "erf",
                "softsign", "sin", "cos", "arctan", "sinh", "cosh",
                "arcsinh", "expm1"]:
     case(opname, (lambda op: lambda x: getattr(nd, op)(x))(opname),
-         [_arr(S, seed=hash(opname) % 100)])
+         [_arr(S, seed=zlib.crc32(opname.encode()) % 100)])
 
 for opname in ["log", "sqrt", "rsqrt", "cbrt", "reciprocal", "log1p",
                "log2", "log10", "gammaln"]:
     case(opname, (lambda op: lambda x: getattr(nd, op)(x))(opname),
-         [_pos(S, seed=hash(opname) % 100)])
+         [_pos(S, seed=zlib.crc32(opname.encode()) % 100)])
 
 case("abs", lambda x: nd.abs(x), [_away_from_zero(S, 3)])
 case("relu", lambda x: nd.relu(x), [_away_from_zero(S, 4)])
