@@ -57,12 +57,13 @@ class GraphProto(object):
         Returns (Symbol, arg_params, aux_params)."""
         convert_map = get_convert_map()
         for init in graph.initializer:
+            # every initializer becomes a variable whether or not it is
+            # also listed in graph.input (ONNX IR>=4 omits them there)
             self._params[init.name] = nd.array(self._parse_array(init))
+            self._nodes[init.name] = sym.var(init.name)
         for inp in graph.input:
             name = inp if isinstance(inp, str) else inp.name
-            if name not in self._params:
-                self._nodes[name] = sym.var(name)
-            else:
+            if name not in self._nodes:
                 self._nodes[name] = sym.var(name)
         for node in graph.node:
             op_type = node.op_type

@@ -64,16 +64,15 @@ def _gemm(attrs, inputs, proto):
         a = sym.transpose(a, axes=(1, 0))
     if not trans_b:
         b = sym.transpose(b, axes=(1, 0))
+    if alpha != 1.0:
+        a = a * alpha          # alpha scales only A·B, never beta·C
     num_hidden = proto.weight_shape(inputs[1])[0 if trans_b else 1]
     args = [a, b]
     if len(inputs) > 2:
         bias = inputs[2] if beta == 1.0 else inputs[2] * beta
         args.append(bias)
-    out = sym.FullyConnected(*args, num_hidden=num_hidden,
-                             no_bias=len(inputs) < 3)
-    if alpha != 1.0:
-        out = out * alpha
-    return out
+    return sym.FullyConnected(*args, num_hidden=num_hidden,
+                              no_bias=len(inputs) < 3)
 
 
 @register("MatMul")
@@ -221,8 +220,16 @@ def _identity(attrs, inputs, proto):
 
 @register("Clip")
 def _clip(attrs, inputs, proto):
-    return sym.clip(inputs[0], a_min=attrs.get("min", -3.4e38),
-                    a_max=attrs.get("max", 3.4e38))
+    # opset-6: min/max attributes; opset-11+: min/max constant inputs
+    a_min = attrs.get("min")
+    a_max = attrs.get("max")
+    if a_min is None and len(inputs) > 1:
+        a_min = float(proto.constant_value(inputs[1]))
+    if a_max is None and len(inputs) > 2:
+        a_max = float(proto.constant_value(inputs[2]))
+    return sym.clip(inputs[0],
+                    a_min=-3.4e38 if a_min is None else a_min,
+                    a_max=3.4e38 if a_max is None else a_max)
 
 
 @register("Pad")

@@ -29,8 +29,9 @@ class Vocabulary(object):
 
     def _index_counter_keys(self, counter, most_freq_count, min_freq):
         assert isinstance(counter, collections.Counter)
-        budget = None if most_freq_count is None else \
-            most_freq_count - len(self._idx_to_token)
+        # most_freq_count caps counter-derived tokens only; unknown and
+        # reserved tokens ride free (reference vocab.py semantics)
+        budget = most_freq_count
         for token, freq in sorted(counter.items(),
                                   key=lambda kv: (-kv[1], kv[0])):
             if freq < min_freq or (budget is not None and budget <= 0):

@@ -29,7 +29,7 @@ def _split_input_slice(batch_size, work_load_list):
             end = batch_size
         else:
             end = start + int(round(batch_size * w / total))
-        if end > batch_size:
+        if end > batch_size or end <= start:
             raise ValueError("Too many slices. Some splits are empty.")
         slices.append(slice(start, end))
         start = end

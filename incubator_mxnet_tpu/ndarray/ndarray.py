@@ -651,10 +651,16 @@ def waitall():
     for d in list(_DISPATCH_DEVICES):
         try:
             token = jax.device_put(jnp.zeros((), jnp.float32), d)
-            jax.block_until_ready(jax.jit(lambda t: t + 1)(token))
+            jax.block_until_ready(_WAITALL_BARRIER(token))
         except Exception:           # device gone / backend quirk
             pass
     _DISPATCH_DEVICES.clear()
+
+
+@jax.jit
+def _WAITALL_BARRIER(t):
+    # compiled once; executes after everything queued before it per stream
+    return t + 1
 
 
 def concatenate(arrays, axis=0, always_copy=True):

@@ -1,0 +1,89 @@
+"""Python side of the C predict API (src/predict/c_predict_api.cc).
+
+The native MXPred* functions embed an interpreter and drive this module:
+``create_predictor(symbol_json, param_bytes, input_shapes)`` returns an
+object with set_input/forward/output_shape/output_bytes — a minimal
+deployment surface mirroring the reference's c_predict_api.cc
+PredictorObj.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import tempfile
+
+import numpy as np
+
+__all__ = ["Predictor", "create_predictor"]
+
+
+class Predictor(object):
+    """One bound inference graph (ref: c_predict_api.cc PredictorObj)."""
+
+    def __init__(self, symbol_json, param_bytes, input_shapes):
+        from . import symbol as sym_mod
+        from . import ndarray as nd
+        from .context import cpu
+
+        self._sym = sym_mod.load_json(symbol_json)
+        # .params bytes → name → NDArray (arg:/aux: prefixes optional)
+        with tempfile.NamedTemporaryFile(delete=False) as f:
+            f.write(param_bytes)
+            path = f.name
+        try:
+            loaded = nd.load(path)
+        finally:
+            os.unlink(path)
+        arg_params, aux_params = {}, {}
+        if isinstance(loaded, dict):
+            for k, v in loaded.items():
+                if k.startswith("arg:"):
+                    arg_params[k[4:]] = v
+                elif k.startswith("aux:"):
+                    aux_params[k[4:]] = v
+                else:
+                    arg_params[k] = v
+        self._input_shapes = {k: tuple(int(d) for d in v)
+                              for k, v in input_shapes.items()}
+        args = {}
+        arg_shapes, _, aux_shapes = self._sym.infer_shape(
+            **self._input_shapes)
+        for name, shape in zip(self._sym.list_arguments(), arg_shapes):
+            if name in arg_params:
+                args[name] = arg_params[name]
+            else:
+                args[name] = nd.zeros(shape)
+        aux = {}
+        for name, shape in zip(self._sym.list_auxiliary_states(),
+                               aux_shapes):
+            aux[name] = (aux_params[name] if name in aux_params
+                         else nd.zeros(shape))
+        self._exe = self._sym.bind(cpu(), args, grad_req="null",
+                                   aux_states=aux)
+        self._outputs = []
+
+    def set_input(self, key, data_bytes):
+        arr = np.frombuffer(data_bytes, np.float32).reshape(
+            self._input_shapes[key])
+        from . import ndarray as nd
+        self._exe.arg_dict[key]._write(
+            nd.array(arr)._read().astype(
+                self._exe.arg_dict[key]._read().dtype))
+        return True
+
+    def forward(self):
+        self._outputs = self._exe.forward(is_train=False)
+        return True
+
+    def output_shape(self, index):
+        return tuple(int(d) for d in self._outputs[index].shape)
+
+    def output_bytes(self, index):
+        return np.ascontiguousarray(
+            self._outputs[index].asnumpy().astype(np.float32)).tobytes()
+
+
+def create_predictor(symbol_json, param_bytes, input_shapes):
+    """Entry point called from the C shim (MXPredCreate)."""
+    return Predictor(symbol_json, param_bytes, input_shapes)

@@ -46,14 +46,21 @@ class MXRecordIO(object):
         self.open()
 
     def open(self):
+        from . import _native
+        from . import config as _config
+        use_native = (_config.get_bool("NATIVE_IO", True)
+                      and _native.available())
         if self.flag == "w":
-            self.handle = open(self.uri, "wb")
             self.writable = True
+            self.handle = (_native.NativeRecordWriter(self.uri) if use_native
+                           else open(self.uri, "wb"))
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
             self.writable = False
+            self.handle = (_native.NativeRecordReader(self.uri) if use_native
+                           else open(self.uri, "rb"))
         else:
             raise ValueError("Invalid flag %s" % self.flag)
+        self._native_handle = use_native
         self.is_open = True
 
     def __del__(self):
@@ -94,9 +101,13 @@ class MXRecordIO(object):
         self.open()
 
     def write(self, buf):
-        """Write one record (ref: MXRecordIOWriterWriteRecord)."""
+        """Write one record (ref: MXRecordIOWriterWriteRecord; native
+        path: src/io/recordio.cc MXTPURecordIOWriterWrite)."""
         assert self.writable
         data = bytes(buf)
+        if self._native_handle:
+            self.handle.write(data)     # framing done in C++
+            return
         # dmlc recordio: no escaping needed for our write path because we
         # write magic-aligned records with explicit length framing
         self.handle.write(struct.pack("<II", _kMagic,
@@ -107,8 +118,11 @@ class MXRecordIO(object):
             self.handle.write(b"\x00" * pad)
 
     def read(self):
-        """Read one record, or None at EOF (ref: MXRecordIOReaderReadRecord)."""
+        """Read one record, or None at EOF (ref: MXRecordIOReaderReadRecord;
+        native path: src/io/recordio.cc MXTPURecordIOReaderNext)."""
         assert not self.writable
+        if self._native_handle:
+            return self.handle.read()   # whole-record read in C++
         head = self.handle.read(8)
         if len(head) < 8:
             return None

@@ -1,0 +1,199 @@
+// Native RecordIO data plane.
+//
+// TPU-native rebirth of the reference's C++ IO layer (dmlc-core
+// recordio.h + src/io/iter_image_recordio_2.cc's threaded record
+// reader): the same magic-framed wire format
+//   [kMagic:4B][cflag:3b|len:29b:4B][payload][pad to 4B]
+// read and written natively, plus a background-thread prefetching
+// reader (bounded ring of parsed records) so record parsing and file IO
+// overlap Python-side decode — the role ThreadedIter played for
+// ImageRecordIter2 (SURVEY §2.1 Data IO).
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in this
+// toolchain); incubator_mxnet_tpu/recordio.py picks it up when built.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+struct Record {
+  std::vector<char> data;
+};
+
+// ---------------------------------------------------------------------------
+// plain sequential reader/writer
+// ---------------------------------------------------------------------------
+
+struct Reader {
+  FILE* fp = nullptr;
+  std::vector<char> buf;   // last record, handed to the caller
+};
+
+struct Writer {
+  FILE* fp = nullptr;
+};
+
+bool read_one(FILE* fp, std::vector<char>* out) {
+  out->clear();
+  uint32_t head[2];
+  for (;;) {
+    if (std::fread(head, sizeof(uint32_t), 2, fp) != 2) return false;
+    if (head[0] != kMagic) return false;
+    uint32_t cflag = head[1] >> 29;
+    uint32_t len = head[1] & ((1u << 29) - 1);
+    size_t off = out->size();
+    out->resize(off + len);
+    if (len && std::fread(out->data() + off, 1, len, fp) != len) return false;
+    uint32_t pad = (4 - len % 4) % 4;
+    if (pad) std::fseek(fp, pad, SEEK_CUR);
+    // cflag: 0 whole, 1 begin, 2 middle, 3 end of a split record
+    if (cflag == 0 || cflag == 3) return true;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* MXTPURecordIOReaderCreate(const char* path) {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) return nullptr;
+  auto* r = new Reader();
+  r->fp = fp;
+  return r;
+}
+
+// 1 = record available (out/size valid until the next call), 0 = EOF/error
+int MXTPURecordIOReaderNext(void* handle, const char** out, uint64_t* size) {
+  auto* r = static_cast<Reader*>(handle);
+  if (!read_one(r->fp, &r->buf)) return 0;
+  *out = r->buf.data();
+  *size = r->buf.size();
+  return 1;
+}
+
+void MXTPURecordIOReaderSeek(void* handle, uint64_t pos) {
+  auto* r = static_cast<Reader*>(handle);
+  std::fseek(r->fp, static_cast<long>(pos), SEEK_SET);
+}
+
+uint64_t MXTPURecordIOReaderTell(void* handle) {
+  auto* r = static_cast<Reader*>(handle);
+  return static_cast<uint64_t>(std::ftell(r->fp));
+}
+
+void MXTPURecordIOReaderFree(void* handle) {
+  auto* r = static_cast<Reader*>(handle);
+  if (r->fp) std::fclose(r->fp);
+  delete r;
+}
+
+void* MXTPURecordIOWriterCreate(const char* path) {
+  FILE* fp = std::fopen(path, "wb");
+  if (!fp) return nullptr;
+  auto* w = new Writer();
+  w->fp = fp;
+  return w;
+}
+
+uint64_t MXTPURecordIOWriterTell(void* handle) {
+  auto* w = static_cast<Writer*>(handle);
+  return static_cast<uint64_t>(std::ftell(w->fp));
+}
+
+int MXTPURecordIOWriterWrite(void* handle, const char* data, uint64_t size) {
+  auto* w = static_cast<Writer*>(handle);
+  uint32_t head[2] = {kMagic, static_cast<uint32_t>(size)};  // cflag 0
+  if (std::fwrite(head, sizeof(uint32_t), 2, w->fp) != 2) return -1;
+  if (size && std::fwrite(data, 1, size, w->fp) != size) return -1;
+  uint32_t pad = (4 - size % 4) % 4;
+  static const char zeros[4] = {0, 0, 0, 0};
+  if (pad && std::fwrite(zeros, 1, pad, w->fp) != pad) return -1;
+  return 0;
+}
+
+void MXTPURecordIOWriterFree(void* handle) {
+  auto* w = static_cast<Writer*>(handle);
+  if (w->fp) std::fclose(w->fp);
+  delete w;
+}
+
+// ---------------------------------------------------------------------------
+// threaded prefetching reader (ThreadedIter reborn)
+// ---------------------------------------------------------------------------
+
+struct PrefetchReader {
+  FILE* fp = nullptr;
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable not_empty, not_full;
+  std::deque<Record> queue;
+  size_t capacity = 16;
+  bool done = false;        // producer finished (EOF)
+  bool stop = false;        // consumer requested shutdown
+  Record current;           // record handed to the caller
+
+  void run() {
+    std::vector<char> buf;
+    for (;;) {
+      if (!read_one(fp, &buf)) break;
+      Record rec;
+      rec.data.swap(buf);
+      std::unique_lock<std::mutex> lk(mu);
+      not_full.wait(lk, [&] { return queue.size() < capacity || stop; });
+      if (stop) return;
+      queue.emplace_back(std::move(rec));
+      not_empty.notify_one();
+    }
+    std::unique_lock<std::mutex> lk(mu);
+    done = true;
+    not_empty.notify_all();
+  }
+};
+
+void* MXTPUPrefetchReaderCreate(const char* path, uint64_t capacity) {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) return nullptr;
+  auto* p = new PrefetchReader();
+  p->fp = fp;
+  if (capacity) p->capacity = capacity;
+  p->worker = std::thread([p] { p->run(); });
+  return p;
+}
+
+int MXTPUPrefetchReaderNext(void* handle, const char** out, uint64_t* size) {
+  auto* p = static_cast<PrefetchReader*>(handle);
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->not_empty.wait(lk, [&] { return !p->queue.empty() || p->done; });
+  if (p->queue.empty()) return 0;
+  p->current = std::move(p->queue.front());
+  p->queue.pop_front();
+  p->not_full.notify_one();
+  *out = p->current.data.data();
+  *size = p->current.data.size();
+  return 1;
+}
+
+void MXTPUPrefetchReaderFree(void* handle) {
+  auto* p = static_cast<PrefetchReader*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stop = true;
+    p->not_full.notify_all();
+  }
+  if (p->worker.joinable()) p->worker.join();
+  if (p->fp) std::fclose(p->fp);
+  delete p;
+}
+
+}  // extern "C"

@@ -1,0 +1,193 @@
+// C predict API shim.
+//
+// TPU-native rebirth of the reference's deployment surface
+// (include/mxnet/c_predict_api.h + src/c_api/c_predict_api.cc): a plain
+// C ABI that C/C++ applications link to run inference on a checkpoint
+// (symbol JSON + .params) without writing Python.
+//
+// Where the reference backs this with its C++ graph executor, the
+// compute engine here IS XLA driven through the Python package, so the
+// shim embeds a CPython interpreter and drives
+// incubator_mxnet_tpu through it — the same layering as every other
+// binding in the reference (all of Scala/R/Perl go through one C ABI,
+// SURVEY §1 layer 8/10).
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Predictor {
+  PyObject* obj = nullptr;                 // python-side predictor
+  std::vector<float> out_buf;
+  std::string err;
+};
+
+std::string g_last_error;
+
+void ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+  }
+}
+
+void set_err(const std::string& msg) { g_last_error = msg; }
+
+std::string fetch_py_error() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      msg = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return msg;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXGetLastError() { return g_last_error.c_str(); }
+
+// Create a predictor from symbol JSON + .params bytes.
+// input_keys/input_shape_*: one entry per input, shapes flattened with
+// csr-style indptr, exactly like the reference MXPredCreate signature.
+int MXPredCreate(const char* symbol_json, const void* param_bytes,
+                 int param_size, int /*dev_type*/, int /*dev_id*/,
+                 uint32_t num_input_nodes, const char** input_keys,
+                 const uint32_t* input_shape_indptr,
+                 const uint32_t* input_shape_data, void** out) {
+  ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* mod = PyImport_ImportModule("incubator_mxnet_tpu.predict");
+  if (!mod) {
+    set_err(fetch_py_error());
+    PyGILState_Release(gil);
+    return -1;
+  }
+  PyObject* fn = PyObject_GetAttrString(mod, "create_predictor");
+  PyObject* shapes = PyDict_New();
+  for (uint32_t i = 0; i < num_input_nodes; ++i) {
+    PyObject* shp = PyTuple_New(input_shape_indptr[i + 1] -
+                                input_shape_indptr[i]);
+    for (uint32_t j = input_shape_indptr[i]; j < input_shape_indptr[i + 1];
+         ++j) {
+      PyTuple_SetItem(shp, j - input_shape_indptr[i],
+                      PyLong_FromUnsignedLong(input_shape_data[j]));
+    }
+    PyDict_SetItemString(shapes, input_keys[i], shp);
+    Py_DECREF(shp);
+  }
+  PyObject* params = PyBytes_FromStringAndSize(
+      static_cast<const char*>(param_bytes), param_size);
+  PyObject* res = PyObject_CallFunction(fn, "sOO", symbol_json, params,
+                                        shapes);
+  Py_DECREF(params);
+  Py_DECREF(shapes);
+  Py_DECREF(fn);
+  Py_DECREF(mod);
+  if (res) {
+    auto* p = new Predictor();
+    p->obj = res;
+    *out = p;
+    rc = 0;
+  } else {
+    set_err(fetch_py_error());
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredSetInput(void* handle, const char* key, const float* data,
+                   uint32_t size) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data), size * sizeof(float));
+  PyObject* res = PyObject_CallMethod(p->obj, "set_input", "sO", key, bytes);
+  Py_DECREF(bytes);
+  int rc = res ? 0 : -1;
+  if (!res) set_err(fetch_py_error());
+  Py_XDECREF(res);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredForward(void* handle) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* res = PyObject_CallMethod(p->obj, "forward", nullptr);
+  int rc = res ? 0 : -1;
+  if (!res) set_err(fetch_py_error());
+  Py_XDECREF(res);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredGetOutputShape(void* handle, uint32_t index, uint32_t** shape_data,
+                         uint32_t* shape_ndim) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* res = PyObject_CallMethod(p->obj, "output_shape", "I", index);
+  if (!res) {
+    set_err(fetch_py_error());
+    PyGILState_Release(gil);
+    return -1;
+  }
+  Py_ssize_t n = PyTuple_Size(res);
+  static thread_local std::vector<uint32_t> shape_buf;
+  shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    shape_buf[i] = static_cast<uint32_t>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(res, i)));
+  }
+  Py_DECREF(res);
+  *shape_data = shape_buf.data();
+  *shape_ndim = static_cast<uint32_t>(n);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXPredGetOutput(void* handle, uint32_t index, float* data,
+                    uint32_t size) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* res = PyObject_CallMethod(p->obj, "output_bytes", "I", index);
+  if (!res) {
+    set_err(fetch_py_error());
+    PyGILState_Release(gil);
+    return -1;
+  }
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  PyBytes_AsStringAndSize(res, &buf, &len);
+  size_t want = static_cast<size_t>(size) * sizeof(float);
+  std::memcpy(data, buf, len < static_cast<Py_ssize_t>(want)
+                             ? static_cast<size_t>(len) : want);
+  Py_DECREF(res);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXPredFree(void* handle) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(p->obj);
+  PyGILState_Release(gil);
+  delete p;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,141 @@
+"""Native C++ layer: RecordIO data plane + C predict API.
+
+Parity models: dmlc-core recordio wire format (byte interchange between
+the C++ and Python paths), src/c_api/c_predict_api.cc driven through its
+C ABI (in-process: the embedded-interpreter path sees an already-live
+interpreter and just takes the GIL).
+"""
+import ctypes
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, sym, recordio, _native
+
+pytestmark = pytest.mark.skipif(not _native.available(),
+                                reason="native libs not built")
+
+
+def test_native_write_python_read(tmp_path, monkeypatch):
+    f = str(tmp_path / "a.rec")
+    w = _native.NativeRecordWriter(f)
+    recs = [b"hello", b"x" * 7, b"", b"world!!!"]
+    for r in recs:
+        w.write(r)
+    w.close()
+    monkeypatch.setenv("MXTPU_NATIVE_IO", "0")   # force python reader
+    rd = recordio.MXRecordIO(f, "r")
+    got = []
+    while True:
+        r = rd.read()
+        if r is None:
+            break
+        got.append(r)
+    assert got == recs
+
+
+def test_python_write_native_read(tmp_path, monkeypatch):
+    f = str(tmp_path / "b.rec")
+    monkeypatch.setenv("MXTPU_NATIVE_IO", "0")   # force python writer
+    w = recordio.MXRecordIO(f, "w")
+    recs = [b"abc", b"d" * 13, b"efgh"]
+    for r in recs:
+        w.write(r)
+    w.close()
+    rd = _native.NativeRecordReader(f)
+    got = []
+    while True:
+        r = rd.read()
+        if r is None:
+            break
+        got.append(r)
+    assert got == recs
+
+
+def test_native_indexed_roundtrip(tmp_path):
+    prefix = str(tmp_path / "c")
+    w = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(20):
+        w.write_idx(i, ("rec%03d" % i).encode() * (i + 1))
+    w.close()
+    r = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    # uses the native reader (NATIVE_IO default on) incl. seek
+    assert r._native_handle
+    for i in (5, 0, 19, 7):
+        assert r.read_idx(i) == ("rec%03d" % i).encode() * (i + 1)
+
+
+def test_native_prefetch_reader(tmp_path):
+    f = str(tmp_path / "d.rec")
+    w = recordio.MXRecordIO(f, "w")
+    recs = [os.urandom(64 * (i % 5 + 1)) for i in range(100)]
+    for r in recs:
+        w.write(r)
+    w.close()
+    pr = _native.NativePrefetchReader(f, capacity=8)
+    got = []
+    while True:
+        r = pr.read()
+        if r is None:
+            break
+        got.append(r)
+    pr.close()
+    assert got == recs
+
+
+def test_c_predict_api_in_process(tmp_path):
+    """Drive the MXPred* C ABI via ctypes (embedded-interpreter shim)."""
+    lib_path = os.path.join(os.path.dirname(__file__), "..", "src",
+                            "build", "libmxtpu_predict.so")
+    if not os.path.exists(lib_path):
+        pytest.skip("predict lib not built")
+    lib = ctypes.CDLL(lib_path)
+    lib.MXPredCreate.restype = ctypes.c_int
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    # build + save a tiny model
+    rng = np.random.RandomState(0)
+    net = sym.softmax(sym.FullyConnected(sym.var("data"), num_hidden=3,
+                                         name="fcp"))
+    w = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+    params_path = str(tmp_path / "m.params")
+    nd.save(params_path, {"arg:fcp_weight": nd.array(w),
+                          "arg:fcp_bias": nd.array(b)})
+    param_bytes = open(params_path, "rb").read()
+    sym_json = net.tojson().encode()
+
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint32 * 2)(0, 2)
+    shape = (ctypes.c_uint32 * 2)(2, 4)
+    handle = ctypes.c_void_p()
+    rc = lib.MXPredCreate(sym_json, param_bytes, len(param_bytes), 1, 0,
+                          1, keys, indptr, shape, ctypes.byref(handle))
+    assert rc == 0, lib.MXGetLastError()
+
+    x = rng.randn(2, 4).astype(np.float32)
+    rc = lib.MXPredSetInput(handle, b"data",
+                            x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                            x.size)
+    assert rc == 0, lib.MXGetLastError()
+    assert lib.MXPredForward(handle) == 0, lib.MXGetLastError()
+
+    shape_data = ctypes.POINTER(ctypes.c_uint32)()
+    ndim = ctypes.c_uint32()
+    assert lib.MXPredGetOutputShape(handle, 0, ctypes.byref(shape_data),
+                                    ctypes.byref(ndim)) == 0
+    out_shape = tuple(shape_data[i] for i in range(ndim.value))
+    assert out_shape == (2, 3)
+    out = np.zeros(6, np.float32)
+    assert lib.MXPredGetOutput(
+        handle, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.size) == 0
+    lib.MXPredFree(handle)
+
+    logits = x @ w.T + b
+    e = np.exp(logits - logits.max(1, keepdims=True))
+    ref = e / e.sum(1, keepdims=True)
+    np.testing.assert_allclose(out.reshape(2, 3), ref, rtol=1e-5)
