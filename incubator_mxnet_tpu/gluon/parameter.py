@@ -36,13 +36,18 @@ class Parameter(object):
 
     def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
                  lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
-                 differentiable=True, stype="default", grad_stype="default"):
+                 differentiable=True, stype="default", grad_stype="default",
+                 sharding=None):
         self._var = None
         self._data = None   # OrderedDict[Context, NDArray]
         self._grad = None
         self._ctx_list = None
         self._deferred_init = ()
         self.name = name
+        # per-dimension mesh axis names, e.g. ("tp", None): the GSPMD
+        # rebirth of ctx_group model parallelism (SURVEY §2.4 — placement
+        # is a sharding annotation, the compiler inserts the collectives)
+        self.sharding = tuple(sharding) if sharding is not None else None
         self._differentiable = differentiable
         self._allow_deferred_init = allow_deferred_init
         self._grad_req = None

@@ -99,19 +99,30 @@ class KVStore(object):
         updater set, the update is applied store-side (server semantics).
         """
         keys, values = self._normalize(key, value)
+        entries = []            # ordered (key, reduced) — keys may repeat
         for k, vlist in zip(keys, values):
             if k not in self._store:
                 raise MXNetError("key %s has not been initialized" % k)
             red = self._reduce(vlist)
             if self._compressor is not None:
                 red = self._compressor.compress(k, red)
-            red = self._cross_worker_reduce(red)
+            entries.append((k, red))
+        # one fused cross-worker collective for the whole push
+        # (ref: big-array sharding amortization, kvstore_dist.h — here the
+        # amortization is batching keys into a single allreduce)
+        self._cross_worker_reduce_many([r for _, r in entries])
+        for k, red in entries:
             if self._updater is not None:
                 self._updater(_int_key(k), red, self._store[k])
             else:
                 # no updater: store holds the reduced value (ref:
                 # kvstore_local.h PushImpl assigns local = merged)
                 self._store[k]._write(red._read().astype(self._store[k].dtype))
+
+    def _cross_worker_reduce_many(self, reds):
+        """Single-process store: nothing to do (dist overrides with one
+        fused collective over all values; mutates them in place)."""
+        return reds
 
     def _cross_worker_reduce(self, red):
         """Hook for the dist subclasses: sum across workers. No-op locally."""

@@ -15,3 +15,4 @@ from . import mesh
 from . import dist
 from .mesh import make_mesh, data_parallel_mesh
 from .data_parallel import DataParallelTrainer
+from .moe import ExpertParallelMoE

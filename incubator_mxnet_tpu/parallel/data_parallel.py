@@ -125,15 +125,27 @@ class DataParallelTrainer(object):
                 break
         repl = NamedSharding(self.mesh, P())
         self._params = {}
+        self._param_sharding = {}
         self._trainable = []
         for name, p in blk_params.items():
             v = p.data()._read()
-            self._params[name] = jax.device_put(v, repl)
+            spec = P(*p.sharding) if getattr(p, "sharding", None) else P()
+            sh = NamedSharding(self.mesh, spec)
+            self._param_sharding[name] = sh
+            self._params[name] = jax.device_put(v, sh)
             if p.grad_req != "null":
                 self._trainable.append(name)
-        self._opt_state = {n: jax.tree.map(lambda x: jax.device_put(x, repl),
-                                           self._opt_init(self._params[n]))
-                           for n in self._trainable}
+        # optimizer state shards like its parameter (same layout, so the
+        # fused update stays local — reference mp/rowsparse updates were
+        # likewise colocated with the weight)
+        self._opt_state = {}
+        for n in self._trainable:
+            sh = self._param_sharding[n]
+            self._opt_state[n] = jax.tree.map(
+                lambda x, sh=sh: jax.device_put(
+                    x, sh if getattr(x, "ndim", 0) ==
+                    len(self._params[n].shape) else repl),
+                self._opt_init(self._params[n]))
 
     def sync_params(self):
         """Write device params back into the Block (checkpoint/export path).
@@ -143,7 +155,16 @@ class DataParallelTrainer(object):
         the trainer's mesh.
         """
         blk_params = self.block.collect_params()
+        repl = NamedSharding(self.mesh, P())
+        gather = None
         for name, v in self._params.items():
+            if not v.sharding.is_fully_replicated:
+                # tp/ep-sharded buffers: allgather to replicated first so
+                # the host fetch sees a fully-addressable array even on
+                # multi-host meshes
+                if gather is None:
+                    gather = jax.jit(lambda a: a, out_shardings=repl)
+                v = gather(v)
             blk_params[name].data()._write(jnp.asarray(jax.device_get(v)))
 
     # -- the pure step -----------------------------------------------------
@@ -200,6 +221,13 @@ class DataParallelTrainer(object):
 
         return step
 
+    def _sharding_trees(self):
+        """(param tree, opt-state tree) of NamedShardings — honors
+        per-parameter sharding annotations (tp/ep model parallelism)."""
+        ptree = dict(self._param_sharding)
+        otree = jax.tree.map(lambda x: x.sharding, self._opt_state)
+        return ptree, otree
+
     def compile(self, *example_args):
         """Build + jit the step for the example shapes; returns the jitted fn."""
         if self._params is None:
@@ -208,11 +236,12 @@ class DataParallelTrainer(object):
         if key not in self._jit_cache:
             repl = NamedSharding(self.mesh, P())
             batch = NamedSharding(self.mesh, P("dp"))
+            ptree, otree = self._sharding_trees()
             step = self._make_step(train=True)
             self._jit_cache[key] = jax.jit(
                 step,
-                in_shardings=(repl, repl, repl, batch, batch, repl),
-                out_shardings=(repl, repl, repl, repl),
+                in_shardings=(ptree, otree, repl, batch, batch, repl),
+                out_shardings=(ptree, otree, repl, repl),
                 donate_argnums=(0, 1, 2) if self._donate else ())
         return self._jit_cache[key]
 
@@ -226,6 +255,7 @@ class DataParallelTrainer(object):
         if key not in self._jit_cache:
             repl = NamedSharding(self.mesh, P())
             batch = NamedSharding(self.mesh, P(None, "dp"))
+            ptree, otree = self._sharding_trees()
             step = self._make_step(train=True)
 
             def multi(params, opt_state, rng_key, xs, ys, lr):
@@ -241,8 +271,8 @@ class DataParallelTrainer(object):
 
             self._jit_cache[key] = jax.jit(
                 multi,
-                in_shardings=(repl, repl, repl, batch, batch, repl),
-                out_shardings=(repl, repl, repl, repl),
+                in_shardings=(ptree, otree, repl, batch, batch, repl),
+                out_shardings=(ptree, otree, repl, repl),
                 donate_argnums=(0, 1, 2) if self._donate else ())
         return self._jit_cache[key]
 

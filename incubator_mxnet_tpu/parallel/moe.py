@@ -1,0 +1,94 @@
+"""Mixture-of-Experts with expert parallelism.
+
+A new capability mandated by SURVEY §2.4 (the reference, a 2018
+framework, has no EP row to port — "EP via sharded gather/scatter —
+these are *new capabilities*"): a switch-style MoE feed-forward block
+whose stacked expert weights shard over the mesh "ep" axis.
+
+Design (TPU-first): dispatch is expressed as einsums over the expert
+dimension — ``combine[n,e] · (x[n,d] @ W[e,d,h])`` — with the ``e``
+dimension sharded.  GSPMD partitions the expert contraction so each
+device computes only its local experts and inserts the psum that merges
+expert outputs over ICI; no hand-written all-to-all.  (A capacity-based
+token-routing variant trades the masked compute for explicit
+``all_to_all`` — the classic Switch formulation — and drops in behind
+the same module interface.)
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..gluon.block import HybridBlock
+from ..ndarray import NDArray
+
+__all__ = ["ExpertParallelMoE"]
+
+
+class ExpertParallelMoE(HybridBlock):
+    """Switch-style top-k MoE FFN (experts sharded over mesh axis "ep").
+
+    Parameters live stacked: gate (d, E), expert weights (E, d, h) and
+    (E, h, d).  Set ``ep_axis`` to the mesh axis name that shards the
+    expert dimension (annotated on the parameters; DataParallelTrainer
+    places them accordingly).
+    """
+
+    def __init__(self, hidden_size, num_experts, top_k=1, ep_axis="ep",
+                 prefix=None, params=None, **kwargs):
+        super().__init__(prefix=prefix, params=params, **kwargs)
+        self._hidden = hidden_size
+        self._num_experts = num_experts
+        self._top_k = int(top_k)
+        with self.name_scope():
+            self.gate_weight = self.params.get(
+                "gate_weight", shape=(0, num_experts),
+                allow_deferred_init=True)
+            self.expert_w1 = self.params.get(
+                "expert_w1", shape=(num_experts, 0, hidden_size),
+                allow_deferred_init=True)
+            self.expert_w2 = self.params.get(
+                "expert_w2", shape=(num_experts, hidden_size, 0),
+                allow_deferred_init=True)
+        # shard the expert dimension over "ep": each device owns E/ep
+        # experts' weights and their compute
+        self.expert_w1.sharding = (ep_axis, None, None)
+        self.expert_w2.sharding = (ep_axis, None, None)
+
+    def _pre_infer(self, x):
+        """Layer-local deferred-shape fill from the live input."""
+        d = int(x.shape[-1])
+        if self.gate_weight.shape[0] == 0:
+            self.gate_weight.shape = (d, self._num_experts)
+            self.expert_w1.shape = (self._num_experts, d, self._hidden)
+            self.expert_w2.shape = (self._num_experts, self._hidden, d)
+
+    def hybrid_forward(self, F, x, gate_weight=None, expert_w1=None,
+                       expert_w2=None):
+        """x: (N, d) → (N, d).  Top-k gating with probability-weighted
+        combine; the expert einsums carry the sharded E dimension."""
+        xv = x._read() if isinstance(x, NDArray) else x
+        gw = gate_weight._read() if isinstance(gate_weight, NDArray) \
+            else gate_weight
+        w1 = expert_w1._read() if isinstance(expert_w1, NDArray) else expert_w1
+        w2 = expert_w2._read() if isinstance(expert_w2, NDArray) else expert_w2
+
+        logits = xv @ gw                               # (N, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        if self._top_k < self._num_experts:
+            top_vals, _ = jax.lax.top_k(probs, self._top_k)
+            thresh = top_vals[..., -1:]
+            mask = probs >= thresh
+            gated = jnp.where(mask, probs, 0.0)
+            # renormalize over the selected experts (Switch/Top-k combine)
+            combine = gated / jnp.maximum(
+                gated.sum(-1, keepdims=True), 1e-9)
+        else:
+            combine = probs
+        # per-expert FFN, expert dim sharded: h[e] = relu(x @ W1[e]) @ W2[e]
+        h = jax.nn.relu(jnp.einsum("nd,edh->neh", xv, w1))
+        y = jnp.einsum("neh,ehd->ned", h, w2)
+        out = jnp.einsum("ne,ned->nd", combine, y)
+        return NDArray(out) if isinstance(x, NDArray) else out

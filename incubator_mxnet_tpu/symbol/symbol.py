@@ -627,10 +627,24 @@ def Group(symbols):
     return s
 
 
+def _upgrade_legacy_json(graph):
+    """Normalize pre-1.0 graph JSON in place (the versioned upgrade pass
+    of src/nnvm/legacy_json_util.cc:197): MXNet 0.x wrote op params under
+    "param"/"attr" instead of "attrs"."""
+    for entry in graph.get("nodes", ()):
+        if "attrs" not in entry:
+            merged = {}
+            merged.update(entry.pop("param", None) or {})
+            merged.update(entry.pop("attr", None) or {})
+            if merged:
+                entry["attrs"] = merged
+    return graph
+
+
 def load_json(json_str):
-    """Rebuild a Symbol from JSON (ref: symbol.py load_json; versioned
-    upgrade path of legacy_json_util.cc collapses to one format here)."""
-    graph = json.loads(json_str)
+    """Rebuild a Symbol from JSON (ref: symbol.py load_json +
+    legacy_json_util.cc LoadLegacyJSONPass for 0.x files)."""
+    graph = _upgrade_legacy_json(json.loads(json_str))
     nodes = []
     for entry in graph["nodes"]:
         op_name = entry["op"]
