@@ -57,6 +57,7 @@ from . import contrib
 from . import operator
 from . import rnn
 from . import executor_manager
+from . import rtc
 from . import profiler
 from . import config
 from . import visualization

@@ -15,8 +15,8 @@ from . import ndarray as nd
 from . import symbol as sym_mod
 from .base import MXNetError
 
-__all__ = ["save_checkpoint", "load_checkpoint", "FeedForward",
-           "BatchEndParam"]
+__all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint",
+           "resume_from_checkpoint", "FeedForward", "BatchEndParam"]
 
 from .module.base_module import BatchEndParam  # re-export for parity
 
@@ -30,6 +30,36 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     param_name = "%s-%04d.params" % (prefix, epoch)
     nd.save(param_name, save_dict)
     logging.info('Saved checkpoint to "%s"', param_name)
+
+
+def latest_checkpoint(prefix):
+    """Highest epoch number with a ``prefix-NNNN.params`` file, or None.
+
+    The recovery primitive the reference lacked (SURVEY §5.3: "no
+    checkpoint-based auto-resume loop"): pair with
+    :func:`resume_from_checkpoint` to restart training after a failure.
+    """
+    import glob
+    import re
+    best = None
+    for path in glob.glob(glob.escape(prefix) + "-*.params"):
+        m = re.match(re.escape(prefix) + r"-(\d{4,})\.params$", path)
+        if m:
+            e = int(m.group(1))
+            best = e if best is None else max(best, e)
+    return best
+
+
+def resume_from_checkpoint(prefix):
+    """(symbol, arg_params, aux_params, begin_epoch) from the newest
+    checkpoint, or (None, None, None, 0) when none exists — feed straight
+    into ``Module.fit(arg_params=..., begin_epoch=...)`` for crash-safe
+    restarts."""
+    epoch = latest_checkpoint(prefix)
+    if epoch is None:
+        return None, None, None, 0
+    symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+    return symbol, arg_params, aux_params, epoch
 
 
 def load_checkpoint(prefix, epoch):

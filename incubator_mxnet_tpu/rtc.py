@@ -1,0 +1,87 @@
+"""Runtime kernel compilation (ref: python/mxnet/rtc.py + src/common/rtc.cc).
+
+The reference's ``CudaModule`` NVRTC-compiles CUDA C at runtime and
+launches kernels on NDArrays.  The TPU-native equivalent of "user writes
+a kernel, framework compiles it at runtime" is Pallas: ``PallasModule``
+wraps user kernel functions, ``get_kernel().launch(...)`` places the
+pallas_call and hands NDArrays through — same module/kernel/launch
+shape as the reference API, with grid dims playing the same role.
+
+On non-TPU backends the kernel runs through Pallas interpret mode, so
+kernels remain testable on the CPU mesh.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["PallasModule", "PallasKernel", "CudaModule"]
+
+
+class PallasModule(object):
+    """A collection of runtime-compiled kernels
+    (ref: rtc.py CudaModule:42 — source string → module; here the
+    "source" is a dict of Python Pallas kernel functions)."""
+
+    def __init__(self, kernels, exports=()):
+        if not isinstance(kernels, dict) or not kernels:
+            raise MXNetError("PallasModule takes {name: kernel_fn}")
+        self._kernels = dict(kernels)
+        self.exports = tuple(exports) or tuple(kernels)
+
+    def get_kernel(self, name, out_shape=None, out_dtype=None):
+        """Look up an exported kernel (ref: rtc.py get_kernel:112).
+        ``out_shape``/``out_dtype``: output spec; defaults to the first
+        input's at launch."""
+        if name not in self._kernels:
+            raise MXNetError("kernel %r not found (have %s)"
+                             % (name, sorted(self._kernels)))
+        return PallasKernel(name, self._kernels[name], out_shape, out_dtype)
+
+
+class PallasKernel(object):
+    """One launchable kernel (ref: rtc.py CudaKernel:173)."""
+
+    def __init__(self, name, fn, out_shape=None, out_dtype=None):
+        self.name = name
+        self._fn = fn
+        self._out_shape = out_shape
+        self._out_dtype = out_dtype
+        self._compiled = {}
+
+    def launch(self, args, ctx=None, grid_dims=(1,), block_dims=None,
+               shared_mem=0):
+        """Run the kernel over NDArray args; returns the output NDArray
+        (ref: rtc.py CudaKernel.launch:185 — grid_dims maps to the Pallas
+        grid; block_dims/shared_mem are CUDA-isms the TPU compiler owns).
+        """
+        from jax.experimental import pallas as pl
+
+        vals = [a._read() if isinstance(a, NDArray) else jnp.asarray(a)
+                for a in args]
+        grid = tuple(int(g) for g in grid_dims if int(g) > 1) or (1,)
+        out_shape = self._out_shape or tuple(vals[0].shape)
+        out_dtype = self._out_dtype or vals[0].dtype
+        key = (tuple(v.shape for v in vals), tuple(str(v.dtype)
+                                                   for v in vals), grid)
+        call = self._compiled.get(key)
+        if call is None:
+            interpret = jax.default_backend() != "tpu"
+            call = jax.jit(pl.pallas_call(
+                self._fn, grid=grid,
+                out_shape=jax.ShapeDtypeStruct(out_shape, out_dtype),
+                interpret=interpret))
+            self._compiled[key] = call
+        return NDArray(call(*vals))
+
+
+def CudaModule(*args, **kwargs):  # noqa: N802 - reference name
+    """The reference entry point: CUDA source cannot target a TPU.
+    Raises with a pointer at PallasModule (the rtc capability here)."""
+    raise MXNetError(
+        "CudaModule compiles CUDA C, which has no TPU target. Use "
+        "mx.rtc.PallasModule with Pallas kernel functions — the runtime "
+        "kernel-compilation path on this backend.")
