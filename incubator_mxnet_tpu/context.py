@@ -46,17 +46,25 @@ class Context:
         return self.devstr2type[self.device_type]
 
     def jax_device(self):
-        """Resolve to a concrete jax.Device."""
+        """Resolve to a concrete jax.Device.
+
+        Multi-process runs: only THIS process's devices are addressable,
+        so contexts index jax.local_devices() (jax.devices() is the
+        global list — rank 1's "cpu(0)" must not resolve to rank 0's
+        device)."""
         import jax
 
+        multiproc = jax.process_count() > 1
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
             backend = "cpu"
             try:
-                devs = jax.devices(backend)
+                devs = (jax.local_devices(backend=backend) if multiproc
+                        else jax.devices(backend))
             except RuntimeError:
-                devs = jax.devices()
+                devs = jax.local_devices() if multiproc else jax.devices()
             return devs[min(self.device_id, len(devs) - 1) if self.device_id >= len(devs) else self.device_id]
-        devs = jax.devices()  # default backend: TPU if present, else host devices
+        # default backend: TPU if present, else host devices
+        devs = jax.local_devices() if multiproc else jax.devices()
         if self.device_id >= len(devs):
             raise ValueError(
                 "context %s: only %d devices available" % (self, len(devs)))

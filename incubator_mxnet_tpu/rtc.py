@@ -36,9 +36,9 @@ class PallasModule(object):
         """Look up an exported kernel (ref: rtc.py get_kernel:112).
         ``out_shape``/``out_dtype``: output spec; defaults to the first
         input's at launch."""
-        if name not in self._kernels:
-            raise MXNetError("kernel %r not found (have %s)"
-                             % (name, sorted(self._kernels)))
+        if name not in self.exports:
+            raise MXNetError("kernel %r is not exported (exports: %s)"
+                             % (name, sorted(self.exports)))
         return PallasKernel(name, self._kernels[name], out_shape, out_dtype)
 
 
@@ -62,9 +62,17 @@ class PallasKernel(object):
 
         vals = [a._read() if isinstance(a, NDArray) else jnp.asarray(a)
                 for a in args]
+        if ctx is not None:
+            dev = ctx.jax_device()
+            vals = [jax.device_put(v, dev) for v in vals]
+        if any(int(g) < 1 for g in grid_dims):
+            raise MXNetError("grid_dims must be positive, got %r"
+                             % (grid_dims,))
         grid = tuple(int(g) for g in grid_dims if int(g) > 1) or (1,)
-        out_shape = self._out_shape or tuple(vals[0].shape)
-        out_dtype = self._out_dtype or vals[0].dtype
+        out_shape = (tuple(self._out_shape) if self._out_shape is not None
+                     else tuple(vals[0].shape))
+        out_dtype = (self._out_dtype if self._out_dtype is not None
+                     else vals[0].dtype)
         key = (tuple(v.shape for v in vals), tuple(str(v.dtype)
                                                    for v in vals), grid)
         call = self._compiled.get(key)
@@ -75,7 +83,8 @@ class PallasKernel(object):
                 out_shape=jax.ShapeDtypeStruct(out_shape, out_dtype),
                 interpret=interpret))
             self._compiled[key] = call
-        return NDArray(call(*vals))
+        return NDArray(call(*vals), ctx=ctx) if ctx is not None \
+            else NDArray(call(*vals))
 
 
 def CudaModule(*args, **kwargs):  # noqa: N802 - reference name
