@@ -129,14 +129,18 @@ def tpu(device_id=0):
 
 
 def num_devices(device_type="tpu"):
+    """Devices this process can address (multi-process: local only, to
+    stay consistent with Context.jax_device resolution)."""
     import jax
 
+    multiproc = jax.process_count() > 1
     if device_type in ("cpu", "cpu_pinned", "cpu_shared"):
         try:
-            return len(jax.devices("cpu"))
+            return len(jax.local_devices(backend="cpu") if multiproc
+                       else jax.devices("cpu"))
         except RuntimeError:
             return 1
-    return len(jax.devices())
+    return len(jax.local_devices() if multiproc else jax.devices())
 
 
 def gpu_memory_info(device_id=0):

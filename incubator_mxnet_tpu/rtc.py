@@ -31,6 +31,10 @@ class PallasModule(object):
             raise MXNetError("PallasModule takes {name: kernel_fn}")
         self._kernels = dict(kernels)
         self.exports = tuple(exports) or tuple(kernels)
+        missing = [n for n in self.exports if n not in self._kernels]
+        if missing:
+            raise MXNetError("exports %s name no kernel (have %s)"
+                             % (missing, sorted(self._kernels)))
 
     def get_kernel(self, name, out_shape=None, out_dtype=None):
         """Look up an exported kernel (ref: rtc.py get_kernel:112).
@@ -83,8 +87,7 @@ class PallasKernel(object):
                 out_shape=jax.ShapeDtypeStruct(out_shape, out_dtype),
                 interpret=interpret))
             self._compiled[key] = call
-        return NDArray(call(*vals), ctx=ctx) if ctx is not None \
-            else NDArray(call(*vals))
+        return NDArray(call(*vals), ctx=ctx)   # ctx=None → current context
 
 
 def CudaModule(*args, **kwargs):  # noqa: N802 - reference name

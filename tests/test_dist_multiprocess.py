@@ -66,14 +66,22 @@ def test_two_process_dist_sync(tmp_path):
         env.get("PYTHONPATH", "")
     env.pop("XLA_FLAGS", None)
     port = 9300 + os.getpid() % 500      # avoid collisions between runs
+    import signal
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "tools", "launch.py"),
+         "-n", "2", "-p", str(port), sys.executable, str(worker)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, start_new_session=True)
     try:
-        res = subprocess.run(
-            [sys.executable, os.path.join(repo, "tools", "launch.py"),
-             "-n", "2", "-p", str(port), sys.executable, str(worker)],
-            env=env, capture_output=True, text=True, timeout=240)
+        stdout, stderr = proc.communicate(timeout=240)
     except subprocess.TimeoutExpired:
-        # a hang here IS the failure mode this test exists to catch
+        # a hang here IS the failure mode this test exists to catch;
+        # kill the whole process group so the workers don't leak
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        proc.wait()
         pytest.fail("2-process dist_sync deadlocked (240s timeout)")
+    res = subprocess.CompletedProcess(proc.args, proc.returncode,
+                                      stdout, stderr)
     out = res.stdout + res.stderr
     assert res.returncode == 0, out[-2000:]
     assert "WORKER 0 OK" in out and "WORKER 1 OK" in out, out[-2000:]

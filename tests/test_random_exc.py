@@ -1,0 +1,83 @@
+"""Random sampler statistics + exception propagation.
+
+Parity models: tests/python/unittest/test_random.py (statistical
+moments), test_exc_handling.py (async errors surface at sync points).
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def test_uniform_moments():
+    mx.random.seed(0)
+    x = nd.random.uniform(low=-2.0, high=4.0, shape=(200000,)).asnumpy()
+    assert abs(x.mean() - 1.0) < 0.02
+    assert abs(x.var() - 36.0 / 12) < 0.05
+    assert x.min() >= -2.0 and x.max() < 4.0
+
+
+def test_normal_moments():
+    mx.random.seed(1)
+    x = nd.random.normal(loc=2.0, scale=3.0, shape=(200000,)).asnumpy()
+    assert abs(x.mean() - 2.0) < 0.03
+    assert abs(x.std() - 3.0) < 0.03
+
+
+def test_gamma_poisson_moments():
+    mx.random.seed(2)
+    g = nd.random.gamma(alpha=4.0, beta=2.0, shape=(100000,)).asnumpy()
+    assert abs(g.mean() - 8.0) < 0.1          # mean = alpha * beta
+    p = nd.random.poisson(lam=3.5, shape=(100000,)).asnumpy()
+    assert abs(p.mean() - 3.5) < 0.05
+    assert abs(p.var() - 3.5) < 0.15
+
+
+def test_seed_reproducibility():
+    mx.random.seed(42)
+    a = nd.random.normal(shape=(16,)).asnumpy()
+    mx.random.seed(42)
+    b = nd.random.normal(shape=(16,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    c = nd.random.normal(shape=(16,)).asnumpy()
+    assert not np.array_equal(b, c)
+
+
+def test_multinomial_distribution():
+    mx.random.seed(3)
+    probs = nd.array(np.array([[0.1, 0.2, 0.7]], np.float32))
+    draws = nd.sample_multinomial(probs, shape=(20000,)).asnumpy().ravel()
+    freq = np.bincount(draws.astype(int), minlength=3) / draws.size
+    np.testing.assert_allclose(freq, [0.1, 0.2, 0.7], atol=0.02)
+
+
+# ---------------------------------------------------------------------------
+# exception propagation (async dispatch must still surface errors)
+# ---------------------------------------------------------------------------
+
+def test_shape_error_raises():
+    with pytest.raises(Exception):
+        nd.dot(nd.ones((2, 3)), nd.ones((4, 5))).asnumpy()
+
+
+def test_invalid_reshape_raises():
+    with pytest.raises(ValueError):
+        nd.ones((2, 3)).reshape((7,))
+
+
+def test_unknown_op_param_raises():
+    with pytest.raises(Exception):
+        nd.Activation(nd.ones((2, 2)), act_type="not_an_act").asnumpy()
+
+
+def test_error_after_async_chain():
+    """Errors raised mid-chain surface when the result is consumed, and
+    the runtime stays usable afterwards (threaded_engine.h exception
+    rethrow contract)."""
+    a = nd.ones((4, 4))
+    b = nd.dot(a, a)                 # fine
+    with pytest.raises(Exception):
+        nd.dot(b, nd.ones((5, 5))).asnumpy()
+    # runtime still healthy
+    assert float(nd.sum(b).asscalar()) == 64.0
