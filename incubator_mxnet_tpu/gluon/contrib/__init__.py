@@ -1,0 +1,5 @@
+"""Gluon contrib: experimental blocks
+(ref: python/mxnet/gluon/contrib/__init__.py).
+"""
+from . import nn
+from . import rnn

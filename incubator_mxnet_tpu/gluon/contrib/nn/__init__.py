@@ -1,0 +1,5 @@
+"""Contrib neural-network layers
+(ref: python/mxnet/gluon/contrib/nn/__init__.py).
+"""
+from .basic_layers import *
+from . import basic_layers

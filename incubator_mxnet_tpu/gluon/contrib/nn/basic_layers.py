@@ -1,0 +1,46 @@
+"""Contrib layers: parallel composition + identity
+(ref: python/mxnet/gluon/contrib/nn/basic_layers.py).
+"""
+from __future__ import annotations
+
+from ...nn.basic_layers import Sequential, HybridSequential
+from ...block import HybridBlock
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity"]
+
+
+class Concurrent(Sequential):
+    """Feed the input to every child; concatenate outputs on ``axis``
+    (ref: basic_layers.py Concurrent:27)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        outs = [block(x) for block in self._children]
+        return nd.concat(*outs, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (ref: basic_layers.py HybridConcurrent:60)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        outs = [block(x) for block in self._children]
+        return F.concat(*outs, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through block, e.g. the skip branch of a HybridConcurrent
+    (ref: basic_layers.py Identity:93)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def hybrid_forward(self, F, x):
+        return x

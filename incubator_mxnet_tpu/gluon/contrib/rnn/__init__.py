@@ -1,0 +1,5 @@
+"""Contrib recurrent cells
+(ref: python/mxnet/gluon/contrib/rnn/__init__.py).
+"""
+from .rnn_cell import *
+from . import rnn_cell

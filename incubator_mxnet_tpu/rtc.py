@@ -72,7 +72,8 @@ class PallasKernel(object):
         if any(int(g) < 1 for g in grid_dims):
             raise MXNetError("grid_dims must be positive, got %r"
                              % (grid_dims,))
-        grid = tuple(int(g) for g in grid_dims if int(g) > 1) or (1,)
+        # keep the full grid rank: size-1 dims still own a program_id axis
+        grid = tuple(int(g) for g in grid_dims) or (1,)
         out_shape = (tuple(self._out_shape) if self._out_shape is not None
                      else tuple(vals[0].shape))
         out_dtype = (self._out_dtype if self._out_dtype is not None

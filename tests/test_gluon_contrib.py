@@ -1,0 +1,54 @@
+"""gluon.contrib layers and cells.
+
+Parity model: tests/python/unittest/test_gluon_contrib.py (Concurrent,
+HybridConcurrent, Identity, VariationalDropoutCell, LSTMPCell).
+"""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+
+
+def test_concurrent_and_identity():
+    x = nd.array(np.random.RandomState(0).randn(2, 3).astype(np.float32))
+    for cls in (gluon.contrib.nn.Concurrent,
+                gluon.contrib.nn.HybridConcurrent):
+        net = cls(axis=1)
+        net.add(gluon.nn.Dense(4))
+        net.add(gluon.contrib.nn.Identity())
+        net.initialize(mx.init.Xavier())
+        out = net(x)
+        assert out.shape == (2, 7)
+        # identity branch passes the input through untouched
+        np.testing.assert_allclose(out.asnumpy()[:, 4:], x.asnumpy(),
+                                   rtol=1e-6)
+
+
+def test_lstmp_cell():
+    cell = gluon.contrib.rnn.LSTMPCell(hidden_size=8, projection_size=3)
+    cell.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(1).randn(2, 4, 5).astype(np.float32))
+    outs, states = cell.unroll(4, x, merge_outputs=True)
+    assert outs.shape == (2, 4, 3)            # projected outputs
+    assert states[0].shape == (2, 3)          # projected h
+    assert states[1].shape == (2, 8)          # full cell state
+
+
+def test_variational_dropout_shares_mask_across_steps():
+    base = gluon.rnn.RNNCell(6)
+    vd = gluon.contrib.rnn.VariationalDropoutCell(base, drop_outputs=0.5)
+    vd.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(2).randn(2, 3, 4).astype(np.float32))
+    with autograd.record(train_mode=True):
+        outs, _ = vd.unroll(3, x, merge_outputs=False)
+    masks = [(o.asnumpy() == 0) for o in outs]
+    assert masks[0].sum() > 0                 # dropout active
+    assert all((m == masks[0]).all() for m in masks[1:])   # same mask
+    # a fresh unroll resets the mask object (new mask drawn per sequence)
+    first_mask_obj = vd.drop_outputs_mask
+    with autograd.record(train_mode=True):
+        vd.unroll(3, x, merge_outputs=False)
+    assert vd.drop_outputs_mask is not first_mask_obj
+    # inference mode: dropout inactive → no exact zeros from masking
+    outs3, _ = vd.unroll(3, x, merge_outputs=False)
+    assert (outs3[0].asnumpy() == 0).sum() == 0
