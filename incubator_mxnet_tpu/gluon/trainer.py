@@ -76,11 +76,13 @@ class Trainer(object):
                 kvstore.set_gradient_compression(self._compression_params)
             if "dist" in kvstore.type:
                 update_on_kvstore = False
+            # one batched init: on dist stores this is a single rank-0
+            # broadcast collective for all params, not one per key
+            kvstore.init(list(range(len(self._params))),
+                         [p.list_data()[0] for p in self._params])
             for i, param in enumerate(self._params):
-                param_arrays = param.list_data()
-                kvstore.init(i, param_arrays[0])
                 if param.grad_req != "null":
-                    kvstore.pull(i, param_arrays, priority=-i)
+                    kvstore.pull(i, param.list_data(), priority=-i)
             if update_on_kvstore:
                 kvstore.set_optimizer(self._optimizer)
             self._kvstore_obj = kvstore

@@ -124,10 +124,6 @@ class KVStore(object):
         fused collective over all values; mutates them in place)."""
         return reds
 
-    def _cross_worker_reduce(self, red):
-        """Hook for the dist subclasses: sum across workers. No-op locally."""
-        return red
-
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast store value into out list (ref: KVStore::Pull)."""
         assert out is not None

@@ -313,9 +313,16 @@ class Module(BaseModule):
         if kvstore_obj:
             if self._compression_params:
                 kvstore_obj.set_gradient_compression(self._compression_params)
-            for idx, name in enumerate(self._param_names):
-                kvstore_obj.init(idx, self._arg_params[name])
+            # one batched init: on dist stores this is a single rank-0
+            # broadcast collective for all params, not one per key
+            kvstore_obj.init(list(range(len(self._param_names))),
+                             [self._arg_params[n] for n in self._param_names])
             if update_on_kvstore:
+                for idx, name in enumerate(self._param_names):
+                    # sync device params to the store's (rank-0) values,
+                    # ref: model.py _initialize_kvstore pull-after-init
+                    kvstore_obj.pull(idx, self._exec_group.param_arrays[idx],
+                                     priority=-idx)
                 kvstore_obj.set_optimizer(self._optimizer)
         if not update_on_kvstore:
             self._updater = opt.get_updater(optimizer)

@@ -32,6 +32,26 @@ from .mesh import data_parallel_mesh
 __all__ = ["DataParallelTrainer", "pure_optimizer"]
 
 
+def _spans_processes(sharding):
+    """True when the sharding places shards on devices of other processes
+    (multi-host mesh) — plain device_put can't reach those."""
+    me = jax.process_index()
+    return any(d.process_index != me for d in sharding.device_set)
+
+
+def _global_put(value, sharding):
+    """device_put that also works on process-spanning meshes: every process
+    builds only its addressable shards from the host value (which multihost
+    callers must hold replicated — see _gather_params' broadcast).  This is
+    the placement role ps-lite's ZPull played; here it's a local slice-and-
+    upload with zero cross-host traffic."""
+    if not _spans_processes(sharding):
+        return jax.device_put(value, sharding)
+    v = np.asarray(value)
+    return jax.make_array_from_callback(v.shape, sharding,
+                                        lambda idx: v[idx])
+
+
 def pure_optimizer(name, **hyper):
     """(init_state, update) pair built from the fused optimizer update ops
     (ops/optimizer_ops.py — the same kernels the eager Optimizer uses)."""
@@ -124,28 +144,41 @@ class DataParallelTrainer(object):
                 self.block._run_deferred_init(NDArray(example_x))
                 break
         repl = NamedSharding(self.mesh, P())
+        multihost = _spans_processes(repl)
+        vals = {n: p.data()._read() for n, p in blk_params.items()}
+        if multihost:
+            # rank 0's initialization wins, exactly the reference's
+            # KVStore::Init broadcast semantics (kvstore_dist.h — first
+            # pushed value defines the key); ONE batched collective
+            from jax.experimental import multihost_utils
+            vals = {n: np.asarray(v)
+                    for n, v in multihost_utils.broadcast_one_to_all(
+                        {n: np.asarray(v) for n, v in vals.items()}).items()}
         self._params = {}
         self._param_sharding = {}
         self._trainable = []
         for name, p in blk_params.items():
-            v = p.data()._read()
             spec = P(*p.sharding) if getattr(p, "sharding", None) else P()
             sh = NamedSharding(self.mesh, spec)
             self._param_sharding[name] = sh
-            self._params[name] = jax.device_put(v, sh)
+            self._params[name] = _global_put(vals[name], sh)
             if p.grad_req != "null":
                 self._trainable.append(name)
         # optimizer state shards like its parameter (same layout, so the
         # fused update stays local — reference mp/rowsparse updates were
-        # likewise colocated with the weight)
+        # likewise colocated with the weight).  Single-host: init runs on
+        # the already-sharded device array, so tp-sharded state is born
+        # sharded (never materialized whole on one device); multihost:
+        # init runs on the host value and shards go up via _global_put.
         self._opt_state = {}
         for n in self._trainable:
             sh = self._param_sharding[n]
+            seed = jnp.asarray(vals[n]) if multihost else self._params[n]
             self._opt_state[n] = jax.tree.map(
-                lambda x, sh=sh: jax.device_put(
+                lambda x, sh=sh, n=n: _global_put(
                     x, sh if getattr(x, "ndim", 0) ==
                     len(self._params[n].shape) else repl),
-                self._opt_init(self._params[n]))
+                self._opt_init(seed))
 
     def sync_params(self):
         """Write device params back into the Block (checkpoint/export path).
@@ -287,17 +320,36 @@ class DataParallelTrainer(object):
             self._gather_params(x[0] if multi else x)
         repl = NamedSharding(self.mesh, P())
         batch_sh = NamedSharding(self.mesh, batch_spec)
+        multihost = _spans_processes(repl)
         if self._rng_key is None:
-            self._rng_key = jax.device_put(random_state.next_key(), repl)
+            key = random_state.next_key()
+            if multihost:
+                # one shared dropout/shuffle stream across hosts (ranks
+                # must trace identical programs with identical constants)
+                from jax.experimental import multihost_utils
+                key = multihost_utils.broadcast_one_to_all(key)
+            self._rng_key = _global_put(key, repl)
         if self._lr_dev is None:
-            self._lr_dev = jax.device_put(jnp.asarray(self._lr, jnp.float32),
-                                          repl)
+            self._lr_dev = _global_put(jnp.asarray(self._lr, jnp.float32),
+                                       repl)
         if not (hasattr(x, "sharding")
                 and x.sharding.is_equivalent_to(batch_sh, x.ndim)):
-            x = jax.device_put(x, batch_sh)
+            if multihost:
+                # each process contributes its LOCAL batch shard; jax glues
+                # them into the global (world_batch, ...) array — the data-
+                # parallel split the reference expressed as per-worker
+                # slices of provide_data (executor_group.py DataParallel)
+                x = jax.make_array_from_process_local_data(batch_sh,
+                                                           np.asarray(x))
+            else:
+                x = jax.device_put(x, batch_sh)
         if not (hasattr(y, "sharding")
                 and y.sharding.is_equivalent_to(batch_sh, y.ndim)):
-            y = jax.device_put(y, batch_sh)
+            if multihost:
+                y = jax.make_array_from_process_local_data(batch_sh,
+                                                           np.asarray(y))
+            else:
+                y = jax.device_put(y, batch_sh)
         return x, y
 
     def step_multi(self, datas, labels):

@@ -20,6 +20,8 @@ import os
 
 import numpy as np
 import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..kvstore import KVStore
 
@@ -70,6 +72,52 @@ def num_dead_nodes():
     return 0
 
 
+# -- in-graph cross-worker reduction ---------------------------------------
+_worker_mesh_cache = None
+_sum_jit_cache = None
+
+
+def worker_mesh():
+    """1-D mesh with ONE device per process — the collective topology of
+    the kvstore wire (the role ps-lite's server group played,
+    kvstore_dist.h).  Summing over its "worker" axis lowers to an XLA
+    all-reduce that rides DCN between hosts (ICI within a slice)."""
+    global _worker_mesh_cache
+    if _worker_mesh_cache is None:
+        devs, seen = [], set()
+        for d in sorted(jax.devices(), key=lambda d: (d.process_index, d.id)):
+            if d.process_index not in seen:
+                seen.add(d.process_index)
+                devs.append(d)
+        _worker_mesh_cache = Mesh(np.array(devs), ("worker",))
+    return _worker_mesh_cache
+
+
+def _global_sum(flat):
+    """Sum one flat buffer across all processes IN-GRAPH: each process
+    contributes its shard of a (num_workers, n) global array laid out over
+    the worker mesh; a jitted sum(axis=0) with replicated output lowers to
+    one XLA all-reduce.  Unlike `multihost_utils.process_allgather` (the
+    round-2 wire), the reduction executes inside XLA — no host round-trip
+    of the gathered buffer, no Python-side sum, and the payload on the wire
+    is the reduce, not an N× gather.  ref: kvstore_dist.h ZPush/ZPull pair
+    collapsed into a single all-reduce."""
+    global _sum_jit_cache
+    mesh = worker_mesh()
+    if _sum_jit_cache is None:
+        _sum_jit_cache = jax.jit(
+            lambda a: a.sum(axis=0),
+            out_shardings=NamedSharding(mesh, P()))
+    me = jax.process_index()
+    my_dev = next(d for d in mesh.devices.flat if d.process_index == me)
+    piece = jax.device_put(flat[None], my_dev)
+    garr = jax.make_array_from_single_device_arrays(
+        (num_workers(),) + tuple(flat.shape),
+        NamedSharding(mesh, P("worker")), [piece])
+    out = _sum_jit_cache(garr)
+    return jnp.asarray(out.addressable_data(0))
+
+
 class DistKVStore(KVStore):
     """dist_sync / dist_device_sync / dist_async over jax.distributed."""
 
@@ -82,43 +130,91 @@ class DistKVStore(KVStore):
                 "(equivalent to dist_sync). See SURVEY.md §2.4.")
         init_process()
 
-    def _cross_worker_reduce(self, red):
-        """Sum one value across workers over DCN/ICI (compression applied
-        by the caller before the wire — 2-bit values in {-t,0,+t} sum
-        exactly, ref: gradient_compression.h)."""
+    def init(self, key, value):
+        """Rank 0's value defines the key globally (ref: kvstore_dist.h
+        Init — the first pushed value wins server-side), so workers that
+        initialized with different seeds still start in sync."""
+        super().init(key, value)
         if num_workers() > 1:
             from jax.experimental import multihost_utils
-            summed = multihost_utils.process_allgather(red._read())
-            red._write(summed.sum(axis=0))
+            keys, _ = self._normalize(key, value)
+            vals = {k: np.asarray(self._store[k]._read()) for k in keys}
+            vals = multihost_utils.broadcast_one_to_all(vals)
+            for k in keys:
+                self._store[k]._write(jnp.asarray(vals[k]).astype(
+                    self._store[k].dtype))
+
+    def _cross_worker_reduce_sparse(self, red):
+        """Union/sum a sparse value across workers.  Row-sparse ships only
+        (row_ids, rows) — padded to the global max row count so every
+        process issues identically-shaped collectives (the fixed-order
+        contract that keeps ranks in lockstep) — then the union rows are
+        segment-summed and written back via .data/.indices (sparse arrays
+        reject dense in-place writes).  ref: kvstore_dist.h PushRowSparse /
+        comm.h ReduceRowSparse."""
+        from jax.experimental import multihost_utils
+        from ..ndarray import NDArray
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(red, RowSparseNDArray):
+            idx = np.asarray(red.indices._read()).astype(np.int64)
+            dat = np.asarray(red.data._read())
+            counts = np.asarray(multihost_utils.process_allgather(
+                jnp.asarray([idx.shape[0]], jnp.int32)))
+            maxn = max(int(counts.max()), 1)
+            pad = maxn - idx.shape[0]
+            idx_p = np.concatenate([idx, np.full((pad,), -1, np.int64)])
+            dat_p = np.concatenate(
+                [dat, np.zeros((pad,) + dat.shape[1:], dat.dtype)])
+            g = multihost_utils.process_allgather(
+                {"i": jnp.asarray(idx_p), "d": jnp.asarray(dat_p)})
+            all_i = np.asarray(g["i"]).reshape(-1)
+            all_d = np.asarray(g["d"]).reshape((-1,) + dat.shape[1:])
+            keep = all_i >= 0
+            all_i, all_d = all_i[keep], all_d[keep]
+            uniq, inv = np.unique(all_i, return_inverse=True)
+            summed = np.zeros((len(uniq),) + dat.shape[1:], dat.dtype)
+            np.add.at(summed, inv, all_d)
+            red.data = NDArray(jnp.asarray(summed))
+            red.indices = NDArray(jnp.asarray(uniq).astype(
+                np.asarray(red.indices._read()).dtype))
+            return red
+        # CSR (and any future stype): reduce dense, rebuild the compressed
+        # form host-side — CSR pushes are rare enough that clarity wins
+        dense = np.asarray(_global_sum(red._read().ravel())).reshape(red.shape)
+        r, c = np.nonzero(dense)
+        red.data = NDArray(jnp.asarray(dense[r, c]))
+        red.indices = NDArray(jnp.asarray(c.astype(np.int64)))
+        red.indptr = NDArray(jnp.asarray(
+            np.searchsorted(r, np.arange(red.shape[0] + 1)).astype(np.int64)))
         return red
 
     def _cross_worker_reduce_many(self, reds):
         """All values of one push in as few collectives as possible:
         same-dtype values pack into one flat buffer (native dtype, so
-        integer sums stay exact), allgather-summed once, and unpacked —
+        integer sums stay exact) and go through ONE in-graph all-reduce —
         latency-bound DCN rounds amortize over the whole push (the
         batching role of the reference's big-array sharding,
-        kvstore_dist.h MXNET_KVSTORE_BIGARRAY_BOUND).  Mutates in place."""
+        kvstore_dist.h MXNET_KVSTORE_BIGARRAY_BOUND).  Iteration order is
+        the caller's key order, which every rank derives from the same
+        enumerate() over parameters — ranks stay in collective lockstep.
+        Mutates in place."""
         if num_workers() <= 1 or not reds:
             return reds
-        import numpy as np
-        import jax.numpy as jnp
-        from jax.experimental import multihost_utils
         from ..ndarray.sparse import BaseSparseNDArray
         groups = {}
         for r in reds:
             if isinstance(r, BaseSparseNDArray):
-                self._cross_worker_reduce(r)    # row-id dedup path
+                self._cross_worker_reduce_sparse(r)    # row-id union path
             else:
                 groups.setdefault(np.dtype(r.dtype), []).append(r)
         for dtype, group in groups.items():
             vals = [r._read() for r in group]
             flat = jnp.concatenate([v.ravel() for v in vals])
-            summed = multihost_utils.process_allgather(flat).sum(axis=0)
+            summed = _global_sum(flat)
             off = 0
             for r, v in zip(group, vals):
                 n = int(np.prod(v.shape))
-                r._write(jnp.asarray(summed[off:off + n]).reshape(v.shape))
+                r._write(summed[off:off + n].reshape(v.shape))
                 off += n
         return reds
 

@@ -1,27 +1,34 @@
-"""Multi-process distributed KVStore: REAL 2-worker dist_sync run.
+"""Multi-process distributed KVStore + end-to-end training: REAL 2-worker runs.
 
-Parity model: tests/nightly/dist_sync_kvstore.py — N worker processes on
-one machine launched via tools/launch.py, asserting exact algebraic
-invariants of sync push/pull (value == sum over workers).  Workers
-rendezvous through the jax coordination service (the ps-lite tracker's
-successor) and reduce over the fused allgather path.
+Parity model: tests/nightly/dist_sync_kvstore.py + tests/nightly/dist_lenet.py
+— N worker processes on one machine launched via tools/launch.py, asserting
+(a) exact algebraic invariants of sync push/pull (value == sum over workers,
+row-sparse union semantics) and (b) that a MODEL trains across processes via
+every user-facing surface: Module.fit(kvstore="dist_sync"), Gluon Trainer,
+and the fused DataParallelTrainer whose gradient psum runs INSIDE the jitted
+step over the process-spanning mesh.  Workers rendezvous through the jax
+coordination service (the ps-lite tracker's successor); the kvstore wire is
+the in-graph all-reduce of parallel/dist.py:_global_sum.
 """
 import os
+import signal
 import subprocess
 import sys
 import textwrap
 
 import pytest
 
-_WORKER = textwrap.dedent("""
-    import os
+_PRELUDE = textwrap.dedent("""
+    import os, sys, traceback
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     import jax
     jax.config.update("jax_platforms", "cpu")
     import numpy as np
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd
+""")
 
+_KV_WORKER = _PRELUDE + textwrap.dedent("""
     kv = mx.kv.create("dist_sync")
     rank, nw = kv.rank, kv.num_workers
     assert nw == 2, nw
@@ -37,6 +44,19 @@ _WORKER = textwrap.dedent("""
     oa, ob = nd.zeros(2), nd.zeros(2)
     kv.pull(["a", "b"], out=[oa, ob])
     assert np.allclose(oa.asnumpy(), 3.0) and np.allclose(ob.asnumpy(), 30.0)
+
+    # row-sparse push: workers hold DIFFERENT row sets; the reduce must
+    # union row ids and sum overlaps (ref: comm.h ReduceRowSparse)
+    kv.init("rs", nd.zeros((6, 3)))
+    dense = np.zeros((6, 3), np.float32)
+    for r in [rank, 2 + rank, 4]:
+        dense[r] = rank + 1
+    kv.push("rs", nd.array(dense).tostype("row_sparse"))
+    ors = nd.zeros((6, 3))
+    kv.pull("rs", out=ors)
+    exp = np.zeros((6, 3), np.float32)
+    exp[0], exp[1], exp[2], exp[3], exp[4] = 1, 2, 1, 2, 3
+    assert np.allclose(ors.asnumpy(), exp), ors.asnumpy()
 
     # one distributed "train step": push local grads (summed across
     # workers), pull, apply — both workers land on identical params
@@ -56,32 +76,123 @@ _WORKER = textwrap.dedent("""
     print("WORKER %d OK" % rank)
 """)
 
+# End-to-end model training across processes — the path that deadlocked in
+# round 2 (collective-order mismatch).  Covers the reference's
+# tests/nightly/dist_lenet.py semantics on all three training surfaces.
+_TRAIN_WORKER = _PRELUDE + textwrap.dedent("""
+    from incubator_mxnet_tpu import gluon, autograd
+    from incubator_mxnet_tpu.parallel import dist
+    from incubator_mxnet_tpu.parallel.data_parallel import DataParallelTrainer
+    from jax.experimental import multihost_utils
 
-def test_two_process_dist_sync(tmp_path):
+    def assert_synced(arr, tag):
+        both = multihost_utils.process_allgather(jax.numpy.asarray(arr))
+        assert np.allclose(both[0], both[1], atol=1e-5), tag + " diverged"
+
+    rng = np.random.RandomState(42)
+    X = rng.randn(64, 10).astype(np.float32)
+    W = rng.randn(10, 1).astype(np.float32)
+    y = (X @ W > 0).astype(np.float32).ravel()
+
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    Xs, ys = X[rank::nw], y[rank::nw]
+
+    # --- surface 1: Module.fit(kvstore="dist_sync") ---------------------
+    data = mx.io.NDArrayIter(Xs, ys, batch_size=8, shuffle=False,
+                             label_name="softmax_label")
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(data, num_epoch=2, kvstore=kv,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.05},
+            initializer=mx.init.Xavier(magnitude=2.0))
+    assert_synced(mod.get_params()[0]["fc1_weight"].asnumpy(), "fit")
+    print("WORKER %d FIT OK" % rank, flush=True)
+
+    # --- surface 2: Gluon Trainer over the dist kvstore -----------------
+    gnet = gluon.nn.Sequential()
+    gnet.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(2))
+    gnet.initialize(mx.init.Xavier(magnitude=2.0))
+    kv2 = mx.kv.create("dist_sync")     # own store: int keys are per-store
+    trainer = gluon.Trainer(gnet.collect_params(), "sgd",
+                            {"learning_rate": 0.05}, kvstore=kv2)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for epoch in range(8):
+        tot = 0.0
+        for i in range(0, len(Xs), 8):
+            xb, yb = nd.array(Xs[i:i+8]), nd.array(ys[i:i+8])
+            with autograd.record():
+                loss = loss_fn(gnet(xb), yb)
+            loss.backward()
+            trainer.step(8 * nw)
+            tot += float(loss.asnumpy().mean())
+        losses.append(tot)
+    assert losses[-1] < losses[0], losses
+    assert_synced(gnet[0].weight.data().asnumpy(), "trainer")
+    print("WORKER %d TRAINER OK" % rank, flush=True)
+
+    # --- surface 3: fused DataParallelTrainer, psum IN the jitted step --
+    hnet = gluon.nn.HybridSequential()
+    hnet.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(2))
+    hnet.initialize(mx.init.Xavier(magnitude=2.0))
+    tr = DataParallelTrainer(hnet, loss_fn, "sgd",
+                             {"learning_rate": 0.05})
+    yl = y.astype(np.int64)
+    dlosses = []
+    for ep in range(10):
+        for i in range(0, 64, 16):
+            lo = rank * 8
+            loss = tr.step(X[i:i+16][lo:lo+8], yl[i:i+16][lo:lo+8])
+            dlosses.append(float(jax.device_get(loss.addressable_data(0))))
+    head, tail = np.mean(dlosses[:4]), np.mean(dlosses[-4:])
+    assert tail < head, (head, tail, dlosses)
+    tr.sync_params()
+    assert_synced(hnet[0].weight.data().asnumpy(), "dpt")
+    print("WORKER %d DPT OK" % rank, flush=True)
+""")
+
+
+def _launch_two(tmp_path, source, timeout=300):
     worker = tmp_path / "worker.py"
-    worker.write_text(_WORKER)
+    worker.write_text(source)
     repo = os.path.join(os.path.dirname(__file__), "..")
     env = dict(os.environ)
     env["PYTHONPATH"] = os.path.abspath(repo) + os.pathsep + \
         env.get("PYTHONPATH", "")
     env.pop("XLA_FLAGS", None)
     port = 9300 + os.getpid() % 500      # avoid collisions between runs
-    import signal
     proc = subprocess.Popen(
         [sys.executable, os.path.join(repo, "tools", "launch.py"),
          "-n", "2", "-p", str(port), sys.executable, str(worker)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True, start_new_session=True)
     try:
-        stdout, stderr = proc.communicate(timeout=240)
+        stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
         # a hang here IS the failure mode this test exists to catch;
         # kill the whole process group so the workers don't leak
         os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
         proc.wait()
-        pytest.fail("2-process dist_sync deadlocked (240s timeout)")
-    res = subprocess.CompletedProcess(proc.args, proc.returncode,
-                                      stdout, stderr)
-    out = res.stdout + res.stderr
-    assert res.returncode == 0, out[-2000:]
+        pytest.fail("2-process dist run deadlocked (%ds timeout)" % timeout)
+    out = stdout + stderr
+    assert proc.returncode == 0, out[-3000:]
+    return out
+
+
+def test_two_process_dist_sync(tmp_path):
+    out = _launch_two(tmp_path, _KV_WORKER, timeout=240)
     assert "WORKER 0 OK" in out and "WORKER 1 OK" in out, out[-2000:]
+
+
+def test_two_process_end_to_end_training(tmp_path):
+    """Round-2's known deadlock path: a model must actually TRAIN across
+    processes on every surface (ref: tests/nightly/dist_lenet.py)."""
+    out = _launch_two(tmp_path, _TRAIN_WORKER, timeout=420)
+    for rank in (0, 1):
+        for tag in ("FIT", "TRAINER", "DPT"):
+            assert "WORKER %d %s OK" % (rank, tag) in out, out[-3000:]
