@@ -80,9 +80,11 @@ class Trainer(object):
             # broadcast collective for all params, not one per key
             kvstore.init(list(range(len(self._params))),
                          [p.list_data()[0] for p in self._params])
+            # pull EVERY param (frozen ones included): on dist stores the
+            # init above broadcast rank 0's values, and a frozen layer left
+            # at its local random init would make ranks diverge forever
             for i, param in enumerate(self._params):
-                if param.grad_req != "null":
-                    kvstore.pull(i, param.list_data(), priority=-i)
+                kvstore.pull(i, param.list_data(), priority=-i)
             if update_on_kvstore:
                 kvstore.set_optimizer(self._optimizer)
             self._kvstore_obj = kvstore

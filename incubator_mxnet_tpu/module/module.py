@@ -323,6 +323,9 @@ class Module(BaseModule):
                     # ref: model.py _initialize_kvstore pull-after-init
                     kvstore_obj.pull(idx, self._exec_group.param_arrays[idx],
                                      priority=-idx)
+                # device arrays may now hold rank 0's broadcast values —
+                # host _arg_params are stale until the next device sync
+                self._params_dirty = True
                 kvstore_obj.set_optimizer(self._optimizer)
         if not update_on_kvstore:
             self._updater = opt.get_updater(optimizer)

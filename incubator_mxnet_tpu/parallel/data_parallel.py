@@ -314,10 +314,11 @@ class DataParallelTrainer(object):
         the raw single-device batch, BEFORE mesh sharding), device-resident
         rng/lr, batch arrays laid out per ``batch_spec`` (resharding
         skipped when already placed)."""
-        x = data._read() if isinstance(data, NDArray) else jnp.asarray(data)
-        y = label._read() if isinstance(label, NDArray) else jnp.asarray(label)
+        x = data._read() if isinstance(data, NDArray) else data
+        y = label._read() if isinstance(label, NDArray) else label
         if self._params is None:
-            self._gather_params(x[0] if multi else x)
+            ex = jnp.asarray(x)
+            self._gather_params(ex[0] if multi else ex)
         repl = NamedSharding(self.mesh, P())
         batch_sh = NamedSharding(self.mesh, batch_spec)
         multihost = _spans_processes(repl)
@@ -332,25 +333,23 @@ class DataParallelTrainer(object):
         if self._lr_dev is None:
             self._lr_dev = _global_put(jnp.asarray(self._lr, jnp.float32),
                                        repl)
-        if not (hasattr(x, "sharding")
-                and x.sharding.is_equivalent_to(batch_sh, x.ndim)):
+        def _place(v):
+            if not hasattr(v, "sharding"):
+                v = np.asarray(v)  # lists / scalars → one host array
+            elif v.sharding.is_equivalent_to(batch_sh, v.ndim):
+                return v
             if multihost:
                 # each process contributes its LOCAL batch shard; jax glues
                 # them into the global (world_batch, ...) array — the data-
                 # parallel split the reference expressed as per-worker
-                # slices of provide_data (executor_group.py DataParallel)
-                x = jax.make_array_from_process_local_data(batch_sh,
-                                                           np.asarray(x))
-            else:
-                x = jax.device_put(x, batch_sh)
-        if not (hasattr(y, "sharding")
-                and y.sharding.is_equivalent_to(batch_sh, y.ndim)):
-            if multihost:
-                y = jax.make_array_from_process_local_data(batch_sh,
-                                                           np.asarray(y))
-            else:
-                y = jax.device_put(y, batch_sh)
-        return x, y
+                # slices of provide_data (executor_group.py DataParallel).
+                # The input stays host-side numpy until this single upload
+                # (no device bounce on the hot path).
+                return jax.make_array_from_process_local_data(batch_sh,
+                                                              np.asarray(v))
+            return jax.device_put(v, batch_sh)
+
+        return _place(x), _place(y)
 
     def step_multi(self, datas, labels):
         """Run K chained steps in one launch; ``datas`` (K, batch, ...),
