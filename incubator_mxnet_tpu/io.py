@@ -165,10 +165,15 @@ class ResizeIter(DataIter):
 
 
 class PrefetchingIter(DataIter):
-    """Threaded double-buffering over base iterator(s)
-    (ref: io.py class PrefetchingIter / src/io/iter_prefetcher.h)."""
+    """Threaded prefetch over base iterator(s), ``prefetch_buffer`` batches
+    deep (ref: io.py class PrefetchingIter / src/io/iter_prefetcher.h —
+    the dmlc ThreadedIter double buffer, generalized to a bounded queue
+    so a bursty consumer can drain several batches without stalling)."""
 
-    def __init__(self, iters, rename_data=None, rename_label=None):
+    _STOP = object()
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_buffer=1):
         super().__init__()
         if not isinstance(iters, list):
             iters = [iters]
@@ -178,35 +183,56 @@ class PrefetchingIter(DataIter):
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = self.provide_data[0][1][0]
-        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
-        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
-        for e in self.data_taken:
-            e.set()
+        self.prefetch_buffer = max(int(prefetch_buffer), 1)
+        self.current_batch = None
         self.started = True
-        self.current_batch = [None for _ in range(self.n_iter)]
-        self.next_batch = [None for _ in range(self.n_iter)]
+        self._start_threads()
 
-        def prefetch_func(self, i):
-            while True:
-                self.data_taken[i].wait()
-                if not self.started:
-                    break
+    def _start_threads(self):
+        import queue
+        self._queues = [queue.Queue(maxsize=self.prefetch_buffer)
+                        for _ in range(self.n_iter)]
+        self._stop_flags = [False] * self.n_iter
+        self._exhausted = False
+
+        def prefetch_func(i):
+            q = self._queues[i]
+            while not self._stop_flags[i]:
                 try:
-                    self.next_batch[i] = self.iters[i].next()
+                    batch = self.iters[i].next()
                 except StopIteration:
-                    self.next_batch[i] = None
-                self.data_taken[i].clear()
-                self.data_ready[i].set()
+                    batch = None
+                while not self._stop_flags[i]:
+                    try:
+                        q.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if batch is None:
+                    return  # epoch exhausted; restarted by reset()
         self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
+            threading.Thread(target=prefetch_func, args=(i,), daemon=True)
             for i in range(self.n_iter)]
         for thread in self.prefetch_threads:
             thread.start()
 
+    def _stop_threads(self):
+        for i in range(self.n_iter):
+            self._stop_flags[i] = True
+        for i, t in enumerate(self.prefetch_threads):
+            # drain so a producer blocked on a full queue can observe stop
+            while t.is_alive():
+                try:
+                    self._queues[i].get_nowait()
+                except Exception:
+                    pass
+                t.join(timeout=0.05)
+
     def __del__(self):
-        self.started = False
-        for e in self.data_taken:
-            e.set()
+        try:
+            self._stop_threads()
+        except Exception:
+            pass
 
     @property
     def provide_data(self):
@@ -227,34 +253,30 @@ class PrefetchingIter(DataIter):
                     for r, i in zip(self.rename_label, self.iters)], [])
 
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
+        self._stop_threads()
         for i in self.iters:
             i.reset()
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        self._start_threads()
 
     def iter_next(self):
-        for e in self.data_ready:
-            e.wait()
-        if self.next_batch[0] is None:
-            for i in self.next_batch:
-                assert i is None, "Number of entry mismatches between iterators"
+        if self._exhausted:
+            # the producer put ONE end-of-epoch sentinel and parked;
+            # keep answering False (Event-era behavior) until reset()
             return False
-        for batch in self.next_batch:
-            assert batch.pad == self.next_batch[0].pad, \
+        batches = [q.get() for q in self._queues]
+        if batches[0] is None:
+            self._exhausted = True
+            for b in batches:
+                assert b is None, "Number of entry mismatches between iterators"
+            return False
+        for batch in batches:
+            assert batch.pad == batches[0].pad, \
                 "Number of entry mismatches between iterators"
         self.current_batch = DataBatch(
-            sum([batch.data for batch in self.next_batch], []),
-            sum([batch.label for batch in self.next_batch], []),
-            self.next_batch[0].pad, self.next_batch[0].index,
+            sum([batch.data for batch in batches], []),
+            sum([batch.label for batch in batches], []),
+            batches[0].pad, batches[0].index,
             provide_data=self.provide_data, provide_label=self.provide_label)
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
         return True
 
     def next(self):
@@ -530,8 +552,11 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=1,
                     label_width=1, shuffle=False, part_index=0, num_parts=1,
                     preprocess_threads=4, prefetch_buffer=4, **kwargs):
     """ImageRecordIter factory (ref: src/io/iter_image_recordio_2.cc:727
-    registration). Returns a prefetched image.ImageIter over the .rec file
-    with the standard augmentation kwargs."""
+    registration). Returns a PrefetchingIter (``prefetch_buffer`` batches
+    deep, background thread) over an image.ImageIter whose decode+augment
+    runs on a ``preprocess_threads``-wide pool, with the standard
+    augmentation kwargs — the layered fused fast path of
+    iter_image_recordio_2.cc:663-762 (reader → parser pool → prefetcher)."""
     from .image import image as img_mod
     known = {}
     aug_keys = ("resize", "rand_crop", "rand_resize", "rand_mirror", "mean",
@@ -560,5 +585,12 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=1,
                            shuffle=shuffle, part_index=part_index,
                            num_parts=num_parts,
                            path_imgidx=kwargs.pop("path_imgidx", None),
+                           preprocess_threads=preprocess_threads,
+                           decode=kwargs.pop("decode", "auto"),
+                           dtype=kwargs.pop("dtype", "float32"),
+                           aug_list=kwargs.pop("aug_list", None),
+                           ctx=kwargs.pop("ctx", None),
                            **known)
+    if prefetch_buffer and int(prefetch_buffer) > 0:
+        return PrefetchingIter(it, prefetch_buffer=prefetch_buffer)
     return it

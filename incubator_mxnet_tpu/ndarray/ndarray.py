@@ -628,7 +628,11 @@ def array(source_array, ctx=None, dtype=None):
             dtype = np.float32 if src.dtype.kind in "fiub" else src.dtype
     src = src.astype(dtype, copy=False)
     ctx = ctx if ctx is not None else current_context()
-    data = jax.device_put(jnp.asarray(src), ctx.jax_device())
+    # device_put straight from host memory: jnp.asarray first would bounce
+    # the buffer through the DEFAULT device (an accelerator upload + a
+    # download when ctx is cpu — measured in seconds through the TPU
+    # tunnel for data-pipeline batches)
+    data = jax.device_put(np.ascontiguousarray(src), ctx.jax_device())
     return NDArray(data, ctx=ctx)
 
 

@@ -218,9 +218,15 @@ class DataParallelTrainer(object):
                 all_vals.update({n: v.astype(cdt)
                                  if v.dtype == jnp.float32 else v
                                  for n, v in trainable_vals.items()})
-                x = x.astype(cdt) if x.dtype == jnp.float32 else x
+                # f32 inputs AND integer images (uint8 data pipeline):
+                # the cast runs on device, keeping host batches cast-free
+                if x.dtype == jnp.float32 or jnp.issubdtype(x.dtype,
+                                                            jnp.integer):
+                    x = x.astype(cdt)
             else:
                 all_vals.update(trainable_vals)
+                if jnp.issubdtype(x.dtype, jnp.integer):
+                    x = x.astype(jnp.float32)
             shadows = {n: NDArray(v) for n, v in all_vals.items()}
             ndx, ndy = NDArray(x), NDArray(y)
             with random_state.use_key(rng):
@@ -317,7 +323,13 @@ class DataParallelTrainer(object):
         x = data._read() if isinstance(data, NDArray) else data
         y = label._read() if isinstance(label, NDArray) else label
         if self._params is None:
-            ex = jnp.asarray(x)
+            # the eager deferred-init pass must see the example on the
+            # SAME device as the Block's params (default backend), and at
+            # compute dtype — host-pinned uint8 pipeline batches are
+            # neither, so round-trip through numpy once here
+            ex = jnp.asarray(np.asarray(x))
+            if jnp.issubdtype(ex.dtype, jnp.integer):
+                ex = ex.astype(jnp.float32)
             self._gather_params(ex[0] if multi else ex)
         repl = NamedSharding(self.mesh, P())
         batch_sh = NamedSharding(self.mesh, batch_spec)

@@ -95,8 +95,14 @@ def make_record(prefix, root, args):
                 m = min(h, w)
                 y0, x0 = (h - m) // 2, (w - m) // 2
                 img = img[y0:y0 + m, x0:x0 + m]
-            s = recordio.pack_img(header, img, quality=args.quality,
-                                  img_fmt=args.encoding)
+            if args.pack_raw:
+                # raw uint8 HWC RGB tensor — ImageIter decode='raw' skips
+                # JPEG entirely (the host-decode-free TPU feeding path)
+                s = recordio.pack(header, cv2.cvtColor(
+                    img, cv2.COLOR_BGR2RGB).tobytes())
+            else:
+                s = recordio.pack_img(header, img, quality=args.quality,
+                                      img_fmt=args.encoding)
         rec.write_idx(idx, s)
         n += 1
         if n % 1000 == 0:
@@ -125,6 +131,9 @@ def main():
     ap.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
     ap.add_argument("--pass-through", action="store_true",
                     help="pack raw files without re-encoding")
+    ap.add_argument("--pack-raw", action="store_true",
+                    help="pack decoded uint8 HWC tensors (no image "
+                         "encoding) for ImageIter's decode='raw' fast path")
     args = ap.parse_args()
 
     if args.list:
