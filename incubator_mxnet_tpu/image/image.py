@@ -412,16 +412,21 @@ class _NativeSeqReader(object):
         from .. import _native
         self._path = path
         self._capacity = capacity
+        self._reads = 0
         self._reader = _native.NativePrefetchReader(path, capacity)
 
     def read(self):
+        self._reads += 1
         return self._reader.read()
 
     def reset(self):
+        if not self._reads:
+            return  # fresh reader (e.g. the reset() in __init__) — keep it
         from .. import _native
         self._reader.close()
         self._reader = _native.NativePrefetchReader(self._path,
                                                     self._capacity)
+        self._reads = 0
 
     def close(self):
         self._reader.close()
@@ -515,6 +520,13 @@ class ImageIter(io_mod.DataIter):
             self.auglist = aug_list
         self.cur = 0
         self.dtype = dtype
+        if np.dtype(dtype) == np.uint8 and self.auglist:
+            # float augmenter output assigned into a uint8 buffer would
+            # wrap silently; the reference's uint8 path
+            # (ImageRecordUInt8Iter) likewise skips augmentation
+            raise ValueError(
+                "dtype='uint8' requires aug_list=[] — augmenters produce "
+                "float images that cannot be stored in a uint8 batch")
         self.preprocess_threads = max(int(preprocess_threads), 1)
         self._decode_mode = decode
         self._pool = None
@@ -560,8 +572,12 @@ class ImageIter(io_mod.DataIter):
         """Payload → HWC uint8 numpy image; raw passthrough when configured.
         Stays in numpy — NDArray wrapping happens only if augmenters run."""
         c, h, w = self.data_shape
+        looks_encoded = bytes(s[:2]) in (b"\xff\xd8", b"\x89P", b"BM", b"GI")
         if self._decode_mode == "raw" or (
-                self._decode_mode == "auto" and len(s) == c * h * w):
+                self._decode_mode == "auto" and len(s) == c * h * w
+                and not looks_encoded):
+            # auto: exact raw-tensor length AND no image magic — a JPEG
+            # that compresses to exactly c*h*w bytes must still decode
             return np.frombuffer(s, np.uint8).reshape(h, w, c)
         import cv2
         img = cv2.imdecode(np.frombuffer(bytes(s), np.uint8),

@@ -164,6 +164,15 @@ class ResizeIter(DataIter):
         return self.current_batch.pad
 
 
+class _PrefetchError(object):
+    """Producer-side exception carrier: re-raised at the consumer's next
+    iter_next() so a corrupt record fails the training loop instead of
+    dying silently on a daemon thread."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
 class PrefetchingIter(DataIter):
     """Threaded prefetch over base iterator(s), ``prefetch_buffer`` batches
     deep (ref: io.py class PrefetchingIter / src/io/iter_prefetcher.h —
@@ -195,23 +204,29 @@ class PrefetchingIter(DataIter):
         self._stop_flags = [False] * self.n_iter
         self._exhausted = False
 
-        def prefetch_func(i):
-            q = self._queues[i]
-            while not self._stop_flags[i]:
+        # the closure must NOT capture self: the producer thread would
+        # otherwise keep the iterator alive forever and __del__ cleanup
+        # could never run
+        def prefetch_func(it, q, flags, i):
+            while not flags[i]:
                 try:
-                    batch = self.iters[i].next()
+                    batch = it.next()
                 except StopIteration:
                     batch = None
-                while not self._stop_flags[i]:
+                except Exception as exc:   # surface errors at the consumer
+                    batch = _PrefetchError(exc)
+                while not flags[i]:
                     try:
                         q.put(batch, timeout=0.1)
                         break
                     except queue.Full:
                         continue
-                if batch is None:
-                    return  # epoch exhausted; restarted by reset()
+                if batch is None or isinstance(batch, _PrefetchError):
+                    return  # epoch exhausted / failed; restarted by reset()
         self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=(i,), daemon=True)
+            threading.Thread(target=prefetch_func,
+                             args=(self.iters[i], self._queues[i],
+                                   self._stop_flags, i), daemon=True)
             for i in range(self.n_iter)]
         for thread in self.prefetch_threads:
             thread.start()
@@ -258,12 +273,21 @@ class PrefetchingIter(DataIter):
             i.reset()
         self._start_threads()
 
+    def close(self):
+        """Stop the producer threads and drop buffered batches.  Call when
+        abandoning the iterator mid-epoch; reset() restarts after it."""
+        self._stop_threads()
+
     def iter_next(self):
         if self._exhausted:
             # the producer put ONE end-of-epoch sentinel and parked;
             # keep answering False (Event-era behavior) until reset()
             return False
         batches = [q.get() for q in self._queues]
+        for b in batches:
+            if isinstance(b, _PrefetchError):
+                self._exhausted = True
+                raise b.exc
         if batches[0] is None:
             self._exhausted = True
             for b in batches:

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..kvstore import KVStore
+from . import compression
 
 __all__ = ["init_process", "rank", "num_workers", "barrier", "DistKVStore"]
 
@@ -207,10 +208,24 @@ class DistKVStore(KVStore):
                 self._cross_worker_reduce_sparse(r)    # row-id union path
             else:
                 groups.setdefault(np.dtype(r.dtype), []).append(r)
+        compress = (self._compressor is not None)
         for dtype, group in groups.items():
             vals = [r._read() for r in group]
             flat = jnp.concatenate([v.ravel() for v in vals])
-            summed = _global_sum(flat)
+            if compress and np.issubdtype(dtype, np.floating):
+                # the push already quantized values to {-t, 0, +t}
+                # (residual kept worker-side); ship ONLY the packed 2-bit
+                # codes — 1/16 the f32 bytes — and dequant+sum locally,
+                # every worker playing the reference server's role
+                # (gradient_compression.h:37-132 + kvstore_dist_server.h
+                # DataHandleCompressed)
+                t = self._compressor.threshold
+                words = compression.encode_2bit(flat, t)
+                gathered = compression.allgather_packed(words, worker_mesh())
+                summed = compression.decode_2bit_sum(
+                    gathered, t, flat.shape[0]).astype(flat.dtype)
+            else:
+                summed = _global_sum(flat)
             off = 0
             for r, v in zip(group, vals):
                 n = int(np.prod(v.shape))

@@ -72,6 +72,23 @@ _KV_WORKER = _PRELUDE + textwrap.dedent("""
     both = multihost_utils.process_allgather(jax.numpy.asarray(w))
     assert np.allclose(both[0], both[1], atol=1e-6), "params diverged"
 
+    # compressed push: the wire ships packed 2-bit words (1/16 bytes,
+    # parallel/compression.py) and dequant+sum must match the residual
+    # algebra exactly.  threshold 0.5; rank0 pushes 0.3 (below threshold,
+    # q=0, residual 0.3), rank1 pushes 0.6 (q=0.5, residual 0.1) -> sum 0.5
+    kvc = mx.kv.create("dist_sync")
+    kvc.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kvc.init("cw", nd.zeros((5,)))
+    kvc.push("cw", nd.ones((5,)) * (0.3 * (rank + 1)))
+    oc = nd.zeros((5,))
+    kvc.pull("cw", out=oc)
+    assert np.allclose(oc.asnumpy(), 0.5), oc.asnumpy()
+    # second identical push: rank0 acc 0.6 -> 0.5 ; rank1 acc 0.7 -> 0.5
+    kvc.push("cw", nd.ones((5,)) * (0.3 * (rank + 1)))
+    kvc.pull("cw", out=oc)
+    assert np.allclose(oc.asnumpy(), 1.0), oc.asnumpy()
+    print("WORKER %d COMPRESS OK" % rank, flush=True)
+
     kv.barrier()
     print("WORKER %d OK" % rank)
 """)
@@ -187,6 +204,8 @@ def _launch_two(tmp_path, source, timeout=300):
 def test_two_process_dist_sync(tmp_path):
     out = _launch_two(tmp_path, _KV_WORKER, timeout=240)
     assert "WORKER 0 OK" in out and "WORKER 1 OK" in out, out[-2000:]
+    assert "WORKER 0 COMPRESS OK" in out and "WORKER 1 COMPRESS OK" in out, \
+        out[-2000:]
 
 
 def test_two_process_end_to_end_training(tmp_path):

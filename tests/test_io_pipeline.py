@@ -146,3 +146,39 @@ def test_uint8_pipeline_keeps_float_labels(tmp_path):
     assert b.data[0].dtype == np.uint8
     np.testing.assert_array_equal(b.label[0].asnumpy(),
                                   [700.0, 701.0, 702.0, 703.0])
+
+
+def test_prefetch_propagates_producer_errors():
+    """A corrupt record must fail the consumer loudly, not hang it."""
+    class Boom(mio.DataIter):
+        def __init__(self):
+            super().__init__(2)
+            self.n = 0
+        @property
+        def provide_data(self):
+            return [mio.DataDesc("data", (2, 2), "float32")]
+        @property
+        def provide_label(self):
+            return [mio.DataDesc("l", (2,), "float32")]
+        def reset(self):
+            self.n = 0
+        def next(self):
+            self.n += 1
+            if self.n == 2:
+                raise ValueError("corrupt record")
+            return mio.DataBatch([mx.nd.zeros((2, 2))], [mx.nd.zeros(2)], 0)
+    pf = mio.PrefetchingIter(Boom(), prefetch_buffer=2)
+    assert pf.iter_next()
+    with pytest.raises(ValueError, match="corrupt record"):
+        pf.iter_next()
+    pf.close()
+
+
+def test_uint8_with_augmenters_rejected(tmp_path):
+    prefix, _ = _write_rec(tmp_path, n=4, raw=True)
+    with pytest.raises(ValueError, match="uint8"):
+        mio.ImageRecordIter(path_imgrec=prefix + ".rec",
+                            path_imgidx=prefix + ".idx",
+                            data_shape=(3, 8, 8), batch_size=4,
+                            dtype="uint8", mean_r=123.0,
+                            preprocess_threads=1, prefetch_buffer=0)
