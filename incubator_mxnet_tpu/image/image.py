@@ -520,13 +520,21 @@ class ImageIter(io_mod.DataIter):
             self.auglist = aug_list
         self.cur = 0
         self.dtype = dtype
-        if np.dtype(dtype) == np.uint8 and self.auglist:
-            # float augmenter output assigned into a uint8 buffer would
-            # wrap silently; the reference's uint8 path
-            # (ImageRecordUInt8Iter) likewise skips augmentation
-            raise ValueError(
-                "dtype='uint8' requires aug_list=[] — augmenters produce "
-                "float images that cannot be stored in a uint8 batch")
+        if np.dtype(dtype) == np.uint8:
+            # range-shifting augmenters (normalize/jitter/lighting) emit
+            # negative / out-of-range floats that would WRAP when stored
+            # in a uint8 batch; geometric augs + cast stay in 0..255 and
+            # are fine (the reference's ImageRecordUInt8Iter likewise
+            # forbids only normalization on the uint8 path)
+            unsafe = (ColorNormalizeAug, LightingAug, ColorJitterAug,
+                      HueJitterAug, BrightnessJitterAug, ContrastJitterAug,
+                      SaturationJitterAug)
+            bad = [a for a in self.auglist if isinstance(a, unsafe)]
+            if bad:
+                raise ValueError(
+                    "dtype='uint8' cannot be combined with range-shifting "
+                    "augmenters %r — their float output would wrap in the "
+                    "uint8 batch buffer" % ([type(a).__name__ for a in bad]))
         self.preprocess_threads = max(int(preprocess_threads), 1)
         self._decode_mode = decode
         self._pool = None
@@ -572,12 +580,16 @@ class ImageIter(io_mod.DataIter):
         """Payload → HWC uint8 numpy image; raw passthrough when configured.
         Stays in numpy — NDArray wrapping happens only if augmenters run."""
         c, h, w = self.data_shape
-        looks_encoded = bytes(s[:2]) in (b"\xff\xd8", b"\x89P", b"BM", b"GI")
+        head = bytes(s[:4])
+        looks_encoded = (head.startswith(b"\xff\xd8\xff")      # JPEG SOI
+                         or head.startswith(b"\x89PNG")        # PNG
+                         or head.startswith(b"GIF8"))          # GIF
         if self._decode_mode == "raw" or (
                 self._decode_mode == "auto" and len(s) == c * h * w
                 and not looks_encoded):
-            # auto: exact raw-tensor length AND no image magic — a JPEG
-            # that compresses to exactly c*h*w bytes must still decode
+            # auto: exact raw-tensor length AND no >=3-byte image magic —
+            # a JPEG that compresses to exactly c*h*w bytes must still
+            # decode, while raw pixels almost never spell a full signature
             return np.frombuffer(s, np.uint8).reshape(h, w, c)
         import cv2
         img = cv2.imdecode(np.frombuffer(bytes(s), np.uint8),

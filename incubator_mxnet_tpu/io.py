@@ -277,6 +277,7 @@ class PrefetchingIter(DataIter):
         """Stop the producer threads and drop buffered batches.  Call when
         abandoning the iterator mid-epoch; reset() restarts after it."""
         self._stop_threads()
+        self._exhausted = True  # iter_next() answers False, never blocks
 
     def iter_next(self):
         if self._exhausted:

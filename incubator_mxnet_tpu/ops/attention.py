@@ -250,3 +250,40 @@ flash_attention.defvjp(_flash_fwd, _flash_bwd)
 def _flash_attention_op(q, k, v, causal=False, scale=None):
     """Registered op wrapper — (B, H, S, D) inputs."""
     return flash_attention(q, k, v, causal, scale)
+
+
+@register("_contrib_RingAttention", num_inputs=3, no_jit=True,
+          aliases=("ring_attention",))
+def _ring_attention_op(q, k, v, seq_axis="sp", causal=False, scale=None):
+    """Exact attention over sequence shards (B, H, S, D): S is sharded on
+    the mesh axis ``seq_axis`` and K/V blocks rotate over ICI
+    (parallel/ring_attention.py).  The mesh comes from the enclosing
+    ``parallel.use_mesh`` scope — the op itself stays array-in/array-out
+    like every registry op.  The modern capability mandated over the
+    reference's bucketing story (SURVEY §5.7)."""
+    from ..parallel.mesh import current_mesh
+    mesh = current_mesh(required=True)
+    if seq_axis not in mesh.axis_names:
+        raise ValueError("mesh %s has no axis %r for ring attention"
+                         % (mesh.axis_names, seq_axis))
+    from ..parallel.ring_attention import ring_attention
+    try:
+        from jax.interpreters.partial_eval import DynamicJaxprTracer
+    except ImportError:  # pragma: no cover - jax internals moved
+        DynamicJaxprTracer = ()
+    if isinstance(q, DynamicJaxprTracer):
+        # staging inside an enclosing jit (e.g. the DataParallelTrainer
+        # step over a dp×sp mesh): the caller's shardings flow in and the
+        # output STAYS sequence-sharded — the real sp training path
+        return ring_attention(q, k, v, mesh, seq_axis, causal, scale)
+    # eager call (including the eager autograd tape's vjp trace, whose
+    # primitives execute immediately): place the sequence shards on the
+    # mesh, run the ring, gather the output back to one device so
+    # downstream eager ops see a plain array.  jax.device_put is traceable
+    # and transposable, so the tape differentiates straight through it.
+    from jax.sharding import NamedSharding, PartitionSpec
+    sh = NamedSharding(mesh, PartitionSpec(None, None, seq_axis, None))
+    home = mesh.devices.flat[0]
+    qs, ks, vs = (jax.device_put(a, sh) for a in (q, k, v))
+    out = ring_attention(qs, ks, vs, mesh, seq_axis, causal, scale)
+    return jax.device_put(out, home)

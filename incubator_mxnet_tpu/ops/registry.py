@@ -66,7 +66,7 @@ class Operator:
                  takes_is_train: bool = False, nograd_inputs=(), mutate_inputs=(),
                  input_names=None, aux_input_names=(), fargnames=None,
                  finfer_params=None, fvisible=None, fnum_outputs=None,
-                 doc: str = ""):
+                 no_jit: bool = False, doc: str = ""):
         self.name = name
         self.fcompute = fcompute
         self.num_inputs = num_inputs
@@ -84,6 +84,9 @@ class Operator:
         self.finfer_params = finfer_params
         self.fvisible = fvisible
         self.fnum_outputs = fnum_outputs   # params → output count (split etc.)
+        self.no_jit = no_jit   # ops that manage their own device placement
+        # (multi-device shard_map bodies): the eager micro-jit would pin
+        # them to the default device and clash with the op's mesh
         self.doc = doc
         self._jit_cache: dict = {}
 
@@ -130,6 +133,8 @@ class Operator:
         CachedOp / engine op-bulking: steady-state eager calls are a dict hit
         + an XLA async dispatch.
         """
+        if self.no_jit:
+            return self.raw(params, is_train)
         key = (_hashable(params), bool(is_train))
         fn = self._jit_cache.get(key)
         if fn is None:

@@ -13,6 +13,8 @@ Modules:
 """
 from . import mesh
 from . import dist
-from .mesh import make_mesh, data_parallel_mesh
+from .mesh import make_mesh, data_parallel_mesh, use_mesh, current_mesh
 from .data_parallel import DataParallelTrainer
 from .moe import ExpertParallelMoE
+from .pipeline import PipelineTrainer
+from .ring_attention import ring_attention, ring_attention_sharded

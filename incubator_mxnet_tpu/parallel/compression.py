@@ -91,17 +91,18 @@ def decode_2bit_sum(words_all, threshold, n):
     return _decode_sum(words_all, jnp.float32(threshold))[:n]
 
 
-_gather_jit = None
+_gather_jit_cache = {}
 
 
 def allgather_packed(words, mesh):
     """Ship THIS process's packed words to every process; returns the
     replicated (num_workers, nwords) uint32 array.  The only bytes that
     cross the wire are the packed codes."""
-    global _gather_jit
+    _gather_jit = _gather_jit_cache.get(mesh)
     if _gather_jit is None:
         _gather_jit = jax.jit(lambda a: a,
                               out_shardings=NamedSharding(mesh, P()))
+        _gather_jit_cache[mesh] = _gather_jit
     me = jax.process_index()
     my_dev = next(d for d in mesh.devices.flat if d.process_index == me)
     piece = jax.device_put(words[None], my_dev)

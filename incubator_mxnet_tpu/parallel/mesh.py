@@ -19,9 +19,56 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, PartitionSpec, NamedSharding
 
-__all__ = ["make_mesh", "data_parallel_mesh", "P", "NamedSharding", "Mesh"]
+__all__ = ["make_mesh", "data_parallel_mesh", "P", "NamedSharding", "Mesh",
+           "use_mesh", "current_mesh"]
 
 P = PartitionSpec
+
+import threading as _threading
+
+_mesh_tls = _threading.local()
+
+
+def _stack():
+    # thread-local: concurrent trainers/eval threads must not see each
+    # other's scoped mesh (same reason jax's mesh managers are TLS)
+    if not hasattr(_mesh_tls, "stack"):
+        _mesh_tls.stack = []
+    return _mesh_tls.stack
+
+
+class use_mesh(object):
+    """Scope a mesh as the framework-wide default: layers that need a
+    device topology (gluon.nn.MultiHeadAttention's seq_axis path, the
+    ring-attention op) resolve it from here when not passed explicitly —
+    the role Context lists played for the reference's executors, for mesh
+    axes.  Usable as a context manager or activated for the whole program
+    via ``use_mesh(mesh).activate()``."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        _stack().append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _stack().pop()
+
+    def activate(self):
+        _stack().append(self.mesh)
+        return self.mesh
+
+
+def current_mesh(required=False):
+    """The innermost scoped mesh, or None (raise when ``required``)."""
+    if _stack():
+        return _stack()[-1]
+    if required:
+        raise RuntimeError(
+            "no device mesh in scope — wrap the call in "
+            "`with parallel.use_mesh(make_mesh({...})):` or pass mesh=")
+    return None
 
 
 def make_mesh(axis_sizes, devices=None):

@@ -22,7 +22,8 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-__all__ = ["pipeline_apply", "pipeline_train_step", "make_pipeline_trainer"]
+__all__ = ["pipeline_apply", "pipeline_train_step", "make_pipeline_trainer",
+           "PipelineTrainer"]
 
 
 def _pp_body(params, xs, stage_fn, axis_name):
@@ -131,3 +132,173 @@ def make_pipeline_trainer(stage_fn, loss_fn, mesh, axis="pp",
         return params, loss
 
     return train
+
+
+def _run_block(block, vals_by_name, x, train=True):
+    """Functionalize one Gluon block: run it on a jax array with parameter
+    values substituted (the DataParallelTrainer tracing pattern)."""
+    from ..ndarray import NDArray
+    from .. import autograd
+    shadows = {n: NDArray(v) for n, v in vals_by_name.items()}
+    with autograd._scope(recording=False, training=train):
+        with block._trace_params(shadows):
+            out = block.hybrid_forward_dispatch(NDArray(x))
+    return out._read()
+
+
+class PipelineTrainer(object):
+    """GPipe training for a Gluon ``HybridSequential`` of identical stages.
+
+    The round-2 gap this closes: pipeline parallelism existed only as a
+    raw ``stage(params, x)`` function (make_pipeline_trainer) a framework
+    user could not reach from a Block.  Here the stages ARE Gluon blocks:
+
+        body = nn.HybridSequential()
+        for _ in range(n_stages):
+            body.add(TransformerBlock(...))        # identical structure
+        trainer = PipelineTrainer(body, loss, mesh, pre=embed, post=head)
+        loss = trainer.step(x, y)
+
+    Each mesh "pp" device holds ONE stage's parameters (leaves stacked on
+    a leading stage axis, sharded over the pipeline axis); activations hop
+    stage-to-stage via ppermute; backward re-traverses the schedule in
+    reverse (pipeline_train_step).  ``pre``/``post`` blocks (embedding /
+    head — usually structurally different from the body stages) run
+    replicated outside the ring.
+
+    Constraints (the standard static-schedule formulation): body stages
+    must be structurally identical (same param shapes, activation shape
+    preserved); stochastic layers (Dropout) are not supported inside the
+    scheduled body; BatchNorm aux-state updates inside the body are
+    dropped.  Optimizer: SGD (reference Module-style lr).
+    """
+
+    def __init__(self, net, loss, mesh=None, axis="pp", num_microbatches=None,
+                 learning_rate=0.01, pre=None, post=None):
+        from .mesh import current_mesh
+        self.net = net
+        self.loss = loss
+        self.mesh = mesh if mesh is not None else current_mesh(required=True)
+        self.axis = axis
+        self.num_microbatches = num_microbatches
+        self.learning_rate = learning_rate
+        self.pre = pre
+        self.post = post
+        self._stages = list(net._children)
+        n = self.mesh.shape[axis]
+        if len(self._stages) != n:
+            raise ValueError(
+                "net has %d stage blocks but mesh axis %r has %d devices"
+                % (len(self._stages), axis, n))
+        self._state = None
+        self._jit = None
+
+    # -- parameter plumbing ------------------------------------------------
+    def _gather(self, example_x):
+        from jax.sharding import NamedSharding
+        x = example_x
+        if self.pre is not None:
+            x = self.pre(x)
+        for blk in self._stages:
+            x = blk(x)          # resolves deferred shapes stage by stage
+        if self.post is not None:
+            self.post(x)
+        stage_vals = []
+        for blk in self._stages:
+            vals = [p.data()._read() for p in blk.collect_params().values()]
+            if stage_vals and [v.shape for v in vals] != \
+                    [v.shape for v in stage_vals[0]]:
+                raise ValueError(
+                    "pipeline stages are not structurally identical: %s vs "
+                    "%s" % ([v.shape for v in stage_vals[0]],
+                            [v.shape for v in vals]))
+            stage_vals.append(vals)
+        stacked = [jnp.stack([sv[j] for sv in stage_vals])
+                   for j in range(len(stage_vals[0]))]
+        stage_sh = NamedSharding(self.mesh, P(self.axis))
+        repl = NamedSharding(self.mesh, P())
+        self._stage_names = [list(b.collect_params().keys())
+                             for b in self._stages]
+        self._template_names = list(
+            self._stages[0].collect_params().keys())
+        state = {
+            "stages": [jax.device_put(s, stage_sh) for s in stacked],
+            "pre": {n: jax.device_put(p.data()._read(), repl)
+                    for n, p in (self.pre.collect_params().items()
+                                 if self.pre is not None else [])},
+            "post": {n: jax.device_put(p.data()._read(), repl)
+                     for n, p in (self.post.collect_params().items()
+                                  if self.post is not None else [])},
+        }
+        self._state = state
+
+    def _stage_fn(self):
+        template = self._stages[0]
+        names = self._template_names
+
+        def fn(leaves, act):
+            vals = dict(zip(names, leaves))
+            return _run_block(template, vals, act)
+        return fn
+
+    def _build_jit(self):
+        from jax.sharding import NamedSharding
+        mesh, axis = self.mesh, self.axis
+        stage_sh = NamedSharding(mesh, P(axis))
+        repl = NamedSharding(mesh, P())
+        pre_blk, post_blk, loss_blk = self.pre, self.post, self.loss
+        stage_fn = self._stage_fn()
+        n_micro = self.num_microbatches
+        lr = self.learning_rate
+
+        def objective(state, x, y):
+            from ..ndarray import NDArray
+            if pre_blk is not None:
+                x = _run_block(pre_blk, state["pre"], x)
+            out = pipeline_apply(stage_fn, state["stages"], x, mesh,
+                                 axis=axis, num_microbatches=n_micro)
+            if post_blk is not None:
+                out = _run_block(post_blk, state["post"], out)
+            per = loss_blk(NDArray(out), NDArray(y))
+            return jnp.mean(per._read())
+
+        def step(state, x, y):
+            loss, grads = jax.value_and_grad(objective)(state, x, y)
+            new_state = jax.tree.map(lambda p, g: p - lr * g, state, grads)
+            return new_state, loss
+
+        shardings = {"stages": [stage_sh] * len(self._state["stages"]),
+                     "pre": {n: repl for n in self._state["pre"]},
+                     "post": {n: repl for n in self._state["post"]}}
+        self._jit = jax.jit(step,
+                            in_shardings=(shardings, repl, repl),
+                            out_shardings=(shardings, repl),
+                            donate_argnums=(0,))
+
+    # -- public surface ----------------------------------------------------
+    def step(self, data, label):
+        """One pipeline-parallel training step; returns the device loss."""
+        from ..ndarray import NDArray
+        x = data._read() if isinstance(data, NDArray) else jnp.asarray(data)
+        y = label._read() if isinstance(label, NDArray) else jnp.asarray(label)
+        if self._state is None:
+            self._gather(NDArray(x))
+            self._build_jit()
+        self._state, loss = self._jit(self._state, x, y)
+        return loss
+
+    def sync_params(self):
+        """Write trained values back into the Gluon blocks."""
+        from ..ndarray import NDArray
+        for j, name0 in enumerate(self._template_names):
+            stacked = jax.device_get(self._state["stages"][j])
+            for i, blk in enumerate(self._stages):
+                pname = self._stage_names[i][j]
+                blk.collect_params()[pname].data()._write(
+                    jnp.asarray(stacked[i]))
+        for blk, key in ((self.pre, "pre"), (self.post, "post")):
+            if blk is None:
+                continue
+            for n, p in blk.collect_params().items():
+                p.data()._write(jnp.asarray(
+                    jax.device_get(self._state[key][n])))
