@@ -529,7 +529,16 @@ class ImageIter(io_mod.DataIter):
             unsafe = (ColorNormalizeAug, LightingAug, ColorJitterAug,
                       HueJitterAug, BrightnessJitterAug, ContrastJitterAug,
                       SaturationJitterAug)
-            bad = [a for a in self.auglist if isinstance(a, unsafe)]
+
+            def _flatten_augs(augs):
+                for a in augs:
+                    yield a
+                    # composite augmenters (RandomOrderAug etc.) hold
+                    # their children in .ts — recurse so a wrapped
+                    # normalizer cannot slip past the guard
+                    yield from _flatten_augs(getattr(a, "ts", []))
+            bad = [a for a in _flatten_augs(self.auglist)
+                   if isinstance(a, unsafe)]
             if bad:
                 raise ValueError(
                     "dtype='uint8' cannot be combined with range-shifting "
@@ -583,7 +592,10 @@ class ImageIter(io_mod.DataIter):
         head = bytes(s[:4])
         looks_encoded = (head.startswith(b"\xff\xd8\xff")      # JPEG SOI
                          or head.startswith(b"\x89PNG")        # PNG
-                         or head.startswith(b"GIF8"))          # GIF
+                         or head.startswith(b"GIF8")           # GIF
+                         or head.startswith(b"BM"))            # BMP (2-byte
+        # magic: a raw tensor starting with pixels 66,77 routes to
+        # cv2.imdecode and fails LOUDLY — pass decode='raw' for raw recs)
         if self._decode_mode == "raw" or (
                 self._decode_mode == "auto" and len(s) == c * h * w
                 and not looks_encoded):

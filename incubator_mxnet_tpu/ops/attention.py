@@ -267,23 +267,14 @@ def _ring_attention_op(q, k, v, seq_axis="sp", causal=False, scale=None):
         raise ValueError("mesh %s has no axis %r for ring attention"
                          % (mesh.axis_names, seq_axis))
     from ..parallel.ring_attention import ring_attention
-    try:
-        from jax.interpreters.partial_eval import DynamicJaxprTracer
-    except ImportError:  # pragma: no cover - jax internals moved
-        DynamicJaxprTracer = ()
-    if isinstance(q, DynamicJaxprTracer):
-        # staging inside an enclosing jit (e.g. the DataParallelTrainer
-        # step over a dp×sp mesh): the caller's shardings flow in and the
-        # output STAYS sequence-sharded — the real sp training path
-        return ring_attention(q, k, v, mesh, seq_axis, causal, scale)
-    # eager call (including the eager autograd tape's vjp trace, whose
-    # primitives execute immediately): place the sequence shards on the
-    # mesh, run the ring, gather the output back to one device so
-    # downstream eager ops see a plain array.  jax.device_put is traceable
-    # and transposable, so the tape differentiates straight through it.
-    from jax.sharding import NamedSharding, PartitionSpec
-    sh = NamedSharding(mesh, PartitionSpec(None, None, seq_axis, None))
-    home = mesh.devices.flat[0]
-    qs, ks, vs = (jax.device_put(a, sh) for a in (q, k, v))
-    out = ring_attention(qs, ks, vs, mesh, seq_axis, causal, scale)
-    return jax.device_put(out, home)
+    from ..parallel.mesh import dispatch_on_mesh, gather_home
+    from jax.sharding import PartitionSpec
+    spec = PartitionSpec(None, None, seq_axis, None)
+    out, eager = dispatch_on_mesh(
+        lambda a, b, c: ring_attention(a, b, c, mesh, seq_axis, causal,
+                                       scale),
+        mesh, (spec, spec, spec), q, k, v)
+    # staging (inside e.g. the DataParallelTrainer step over a dp×sp
+    # mesh): output STAYS sequence-sharded; eager: gather home so
+    # downstream single-device ops see a plain array
+    return gather_home(out, mesh) if eager else out

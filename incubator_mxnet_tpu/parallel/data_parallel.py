@@ -366,20 +366,29 @@ class DataParallelTrainer(object):
     def step_multi(self, datas, labels):
         """Run K chained steps in one launch; ``datas`` (K, batch, ...),
         ``labels`` (K, batch).  Returns the last step's device loss."""
+        from .mesh import use_mesh
         xs, ys = self._prepare_inputs(datas, labels, P(None, "dp"),
                                       multi=True)
         fn = self.compile_multi(xs, ys)
-        self._params, self._opt_state, self._rng_key, loss_val = fn(
-            self._params, self._opt_state, self._rng_key, xs, ys,
-            self._lr_dev)
+        with use_mesh(self.mesh):
+            self._params, self._opt_state, self._rng_key, loss_val = fn(
+                self._params, self._opt_state, self._rng_key, xs, ys,
+                self._lr_dev)
         return loss_val
 
     def step(self, data, label):
-        """Run one sharded train step; returns the device scalar loss."""
+        """Run one sharded train step; returns the device scalar loss.
+
+        The trainer's mesh is scoped for the trace (parallel.use_mesh), so
+        mesh-aware layers (MultiHeadAttention(seq_axis=...), capacity MoE)
+        resolve THIS mesh without the caller wrapping every step."""
+        from .mesh import use_mesh
         x, y = self._prepare_inputs(data, label, P("dp"))
         fn = self.compile(x, y)
-        self._params, self._opt_state, self._rng_key, loss_val = fn(
-            self._params, self._opt_state, self._rng_key, x, y, self._lr_dev)
+        with use_mesh(self.mesh):
+            self._params, self._opt_state, self._rng_key, loss_val = fn(
+                self._params, self._opt_state, self._rng_key, x, y,
+                self._lr_dev)
         return loss_val
 
     @property

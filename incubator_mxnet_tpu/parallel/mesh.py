@@ -60,6 +60,40 @@ class use_mesh(object):
         return self.mesh
 
 
+def is_staging(x):
+    """True when ``x`` is a tracer from an enclosing jit's staging trace
+    (as opposed to a concrete array OR an eager-autodiff tracer whose
+    primitives execute immediately)."""
+    try:
+        from jax.interpreters.partial_eval import DynamicJaxprTracer
+    except ImportError:  # pragma: no cover - jax internals moved
+        return False
+    return isinstance(x, DynamicJaxprTracer)
+
+
+def dispatch_on_mesh(fn, mesh, in_specs, *arrays):
+    """Run a collective-bearing ``fn(*arrays)`` correctly in both worlds.
+
+    Staging inside an enclosing jit: call straight through — the caller's
+    shardings flow in and outputs stay sharded.  Eager (including the
+    eager autograd tape, whose vjp primitives execute immediately): place
+    each operand per its PartitionSpec on ``mesh`` first.  Returns
+    ``(outputs, eager)``; eager callers usually want ``gather_home`` on
+    array outputs so downstream single-device ops see plain arrays.
+    """
+    if is_staging(arrays[0]):
+        return fn(*arrays), False
+    placed = [jax.device_put(a, NamedSharding(mesh, s))
+              for a, s in zip(arrays, in_specs)]
+    return fn(*placed), True
+
+
+def gather_home(x, mesh):
+    """Pull a mesh-sharded eager result onto one device (traceable and
+    transposable, so the tape differentiates through it)."""
+    return jax.device_put(x, mesh.devices.flat[0])
+
+
 def current_mesh(required=False):
     """The innermost scoped mesh, or None (raise when ``required``)."""
     if _stack():

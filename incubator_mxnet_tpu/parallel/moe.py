@@ -5,26 +5,98 @@ framework, has no EP row to port — "EP via sharded gather/scatter —
 these are *new capabilities*"): a switch-style MoE feed-forward block
 whose stacked expert weights shard over the mesh "ep" axis.
 
-Design (TPU-first): dispatch is expressed as einsums over the expert
-dimension — ``combine[n,e] · (x[n,d] @ W[e,d,h])`` — with the ``e``
-dimension sharded.  GSPMD partitions the expert contraction so each
-device computes only its local experts and inserts the psum that merges
-expert outputs over ICI; no hand-written all-to-all.  (A capacity-based
-token-routing variant trades the masked compute for explicit
-``all_to_all`` — the classic Switch formulation — and drops in behind
-the same module interface.)
+Two dispatch modes behind one module interface:
+
+* ``dispatch="dense"`` (default) — einsums over the expert dimension,
+  ``combine[n,e] · (x[n,d] @ W[e,d,h])``, with ``e`` sharded.  GSPMD
+  partitions the contraction and inserts the psum merging expert outputs
+  over ICI.  Simple and exact for any top_k, but compute ∝ num_experts.
+* ``dispatch="capacity"`` — the classic Switch formulation: top-1
+  routing with per-expert capacity slots; token activations travel to
+  their expert's device via explicit ``lax.all_to_all`` and back, so
+  compute is independent of num_experts and overflow tokens are dropped
+  (``last_drop_fraction`` reports the rate on eager calls).
 """
 from __future__ import annotations
+
+import functools
+import math
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..gluon.block import HybridBlock
 from ..ndarray import NDArray
 
 __all__ = ["ExpertParallelMoE"]
+
+
+def _switch_body(x, gw, w1, w2, *, axis, num_experts, cap):
+    """Per-device capacity-based Switch dispatch (tokens sharded over the
+    ep axis, experts sharded over the ep axis).
+
+    The classic Switch-Transformer formulation: each token picks its top-1
+    expert; the first ``cap`` tokens per expert get a capacity slot, the
+    rest are DROPPED (output 0 for the FFN branch); dispatched token
+    activations travel to the expert's device via ``lax.all_to_all`` and
+    the expert outputs ride the reverse all-to-all home.  Compute is
+    O(tokens·d·h) — independent of num_experts — where the dense masked
+    path pays num_experts×.
+    """
+    nloc = x.shape[0]
+    logits = x @ gw                                      # (N_l, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                  # (N_l,)
+    onehot = jax.nn.one_hot(expert, num_experts, dtype=x.dtype)
+    # position of each token in its expert's queue (arrival order)
+    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot - onehot,
+                  axis=-1).astype(jnp.int32)
+    keep = (pos < cap).astype(x.dtype)                   # capacity gate
+    disp = onehot * keep[:, None]                        # (N_l, E)
+    slot = jax.nn.one_hot(pos, cap, dtype=x.dtype)       # (N_l, C)
+    dispatch = jnp.einsum("ne,nc->nec", disp, slot)      # (N_l, E, C)
+    ein = jnp.einsum("nec,nd->ecd", dispatch, x)         # (E, C, d)
+    # ship each expert's slot block to the device that owns the expert
+    ein = lax.all_to_all(ein, axis, split_axis=0, concat_axis=1,
+                         tiled=True)                     # (E/P, P·C, d)
+    h = jax.nn.relu(jnp.einsum("gcd,gdh->gch", ein, w1))
+    y = jnp.einsum("gch,ghd->gcd", h, w2)                # (E/P, P·C, d)
+    y = lax.all_to_all(y, axis, split_axis=1, concat_axis=0,
+                       tiled=True)                       # (E, C, d)
+    out = jnp.einsum("nec,ecd->nd", dispatch, y)
+    dropped = 1.0 - jnp.sum(keep) / nloc
+    return out, dropped.reshape(1)
+
+
+def switch_moe_apply(x, gw, w1, w2, mesh, ep_axis="ep",
+                     capacity_factor=1.25):
+    """Capacity-dispatch MoE over ``mesh[ep_axis]``: returns
+    ``(out, drop_frac_per_device)``.  Tokens are sharded over the ep axis
+    for dispatch (N must divide by the axis size); expert weights arrive
+    sharded on their leading expert dim."""
+    num_experts = w1.shape[0]
+    ep = mesh.shape[ep_axis]
+    if x.shape[0] % ep:
+        raise ValueError("token count %d not divisible by ep=%d"
+                         % (x.shape[0], ep))
+    if num_experts % ep:
+        raise ValueError("num_experts %d not divisible by ep=%d"
+                         % (num_experts, ep))
+    nloc = x.shape[0] // ep
+    cap = max(1, int(math.ceil(capacity_factor * nloc / num_experts)))
+    fn = shard_map(
+        functools.partial(_switch_body, axis=ep_axis,
+                          num_experts=num_experts, cap=cap),
+        mesh=mesh,
+        in_specs=(P(ep_axis), P(), P(ep_axis), P(ep_axis)),
+        out_specs=(P(ep_axis), P(ep_axis)),
+        check_vma=False)
+    return fn(x, gw, w1, w2)
 
 
 class ExpertParallelMoE(HybridBlock):
@@ -37,11 +109,22 @@ class ExpertParallelMoE(HybridBlock):
     """
 
     def __init__(self, hidden_size, num_experts, top_k=1, ep_axis="ep",
+                 dispatch="dense", capacity_factor=1.25,
                  prefix=None, params=None, **kwargs):
         super().__init__(prefix=prefix, params=params, **kwargs)
         self._hidden = hidden_size
         self._num_experts = num_experts
         self._top_k = int(top_k)
+        self._ep_axis = ep_axis
+        if dispatch not in ("dense", "capacity"):
+            raise ValueError("dispatch must be 'dense' or 'capacity', got %r"
+                             % (dispatch,))
+        if dispatch == "capacity" and self._top_k != 1:
+            raise ValueError("capacity dispatch implements top-1 Switch "
+                             "routing; use dispatch='dense' for top_k > 1")
+        self._dispatch = dispatch
+        self._capacity_factor = float(capacity_factor)
+        self.last_drop_fraction = None  # updated on eager capacity calls
         with self.name_scope():
             self.gate_weight = self.params.get(
                 "gate_weight", shape=(0, num_experts),
@@ -75,6 +158,10 @@ class ExpertParallelMoE(HybridBlock):
         w1 = expert_w1._read() if isinstance(expert_w1, NDArray) else expert_w1
         w2 = expert_w2._read() if isinstance(expert_w2, NDArray) else expert_w2
 
+        if self._dispatch == "capacity":
+            out = self._capacity_forward(xv, gw, w1, w2)
+            return NDArray(out) if isinstance(x, NDArray) else out
+
         logits = xv @ gw                               # (N, E)
         probs = jax.nn.softmax(logits, axis=-1)
         if self._top_k < self._num_experts:
@@ -92,3 +179,27 @@ class ExpertParallelMoE(HybridBlock):
         y = jnp.einsum("neh,ehd->ned", h, w2)
         out = jnp.einsum("ne,ned->nd", combine, y)
         return NDArray(out) if isinstance(x, NDArray) else out
+
+    def _capacity_forward(self, xv, gw, w1, w2):
+        """Switch all-to-all dispatch over the scoped mesh's ep axis.
+        Eager calls place operands on the mesh, run, and gather the output
+        home (storing ``last_drop_fraction``); inside an enclosing jit the
+        caller's shardings flow through and stats stay on device."""
+        from .mesh import current_mesh, dispatch_on_mesh, gather_home
+        mesh = current_mesh(required=True)
+        if self._ep_axis not in mesh.axis_names:
+            raise ValueError("mesh %s has no axis %r for capacity dispatch"
+                             % (mesh.axis_names, self._ep_axis))
+        ep = self._ep_axis
+        (out, drops), eager = dispatch_on_mesh(
+            lambda a, b, c, d: switch_moe_apply(a, b, c, d, mesh, ep,
+                                                self._capacity_factor),
+            mesh, (P(ep), P(), P(ep), P(ep)), xv, gw, w1, w2)
+        if eager:
+            if not isinstance(drops, jax.core.Tracer):
+                # concrete eager call; under the eager tape's vjp trace
+                # drops is a tracer — stats stay at their last value
+                self.last_drop_fraction = float(
+                    np.mean(jax.device_get(drops)))
+            return gather_home(out, mesh)
+        return out

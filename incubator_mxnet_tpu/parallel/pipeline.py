@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -196,6 +198,7 @@ class PipelineTrainer(object):
     # -- parameter plumbing ------------------------------------------------
     def _gather(self, example_x):
         from jax.sharding import NamedSharding
+        from ..ndarray import NDArray
         x = example_x
         if self.pre is not None:
             x = self.pre(x)
@@ -204,8 +207,13 @@ class PipelineTrainer(object):
         if self.post is not None:
             self.post(x)
         stage_vals = []
+        template = self._stages[0]
         for blk in self._stages:
             vals = [p.data()._read() for p in blk.collect_params().values()]
+            if type(blk) is not type(template):
+                raise ValueError(
+                    "pipeline stages must be the same block type: %s vs %s"
+                    % (type(template).__name__, type(blk).__name__))
             if stage_vals and [v.shape for v in vals] != \
                     [v.shape for v in stage_vals[0]]:
                 raise ValueError(
@@ -213,6 +221,25 @@ class PipelineTrainer(object):
                     "%s" % ([v.shape for v in stage_vals[0]],
                             [v.shape for v in vals]))
             stage_vals.append(vals)
+        # the schedule executes EVERY stage through stage 0's forward
+        # function — same shapes is not enough (Dense(tanh) vs Dense(relu)
+        # would silently compute the wrong model).  Probe: each stage's
+        # own forward must equal the template driven by its params.
+        probe = example_x
+        if self.pre is not None:
+            probe = self.pre(probe)
+        pv = probe._read()
+        names = list(template.collect_params().keys())
+        for blk, vals in zip(self._stages[1:], stage_vals[1:]):
+            own = np.asarray(blk(NDArray(pv))._read())
+            via_tmpl = np.asarray(
+                _run_block(template, dict(zip(names, vals)), pv))
+            if not np.allclose(own, via_tmpl, rtol=1e-5, atol=1e-6):
+                raise ValueError(
+                    "pipeline stage %r computes differently from stage 0 "
+                    "despite identical param shapes (e.g. a different "
+                    "activation/config) — the GPipe schedule requires "
+                    "functionally identical stages" % (blk.name,))
         stacked = [jnp.stack([sv[j] for sv in stage_vals])
                    for j in range(len(stage_vals[0]))]
         stage_sh = NamedSharding(self.mesh, P(self.axis))
@@ -279,12 +306,14 @@ class PipelineTrainer(object):
     def step(self, data, label):
         """One pipeline-parallel training step; returns the device loss."""
         from ..ndarray import NDArray
+        from .mesh import use_mesh
         x = data._read() if isinstance(data, NDArray) else jnp.asarray(data)
         y = label._read() if isinstance(label, NDArray) else jnp.asarray(label)
         if self._state is None:
             self._gather(NDArray(x))
             self._build_jit()
-        self._state, loss = self._jit(self._state, x, y)
+        with use_mesh(self.mesh):
+            self._state, loss = self._jit(self._state, x, y)
         return loss
 
     def sync_params(self):

@@ -183,3 +183,19 @@ def test_multihead_attention_sp_in_fused_trainer():
         for _ in range(15):
             l = float(np.asarray(tr.step(mx.nd.array(x), mx.nd.array(y))))
     assert np.isfinite(l) and l < l0, (l0, l)
+
+
+def test_pipeline_trainer_rejects_divergent_stage_compute():
+    """Same param shapes but different compute (tanh vs relu) must be
+    rejected, not silently run through stage 0's function."""
+    mesh = make_mesh({"pp": 2}, jax.devices("cpu")[:2])
+    body = nn.HybridSequential()
+    a = nn.Dense(4, activation="tanh", flatten=False, in_units=4)
+    b = nn.Dense(4, activation="relu", flatten=False, in_units=4)
+    a.initialize(mx.init.Xavier()); b.initialize(mx.init.Xavier())
+    body.add(a); body.add(b)
+    tr = PipelineTrainer(body, gluon.loss.L2Loss(), mesh,
+                         num_microbatches=2)
+    X = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+    with pytest.raises(ValueError, match="computes differently"):
+        tr.step(X, X)
