@@ -1,0 +1,107 @@
+"""Eager-dispatch micro-benchmark (SURVEY hard-part #2).
+
+The reference engineered engine op-bulking because per-op push overhead
+dominated small-op imperative workloads (threaded_engine.h:472-509
+BulkAppend / MXNET_EXEC_BULK_EXEC_*).  This framework's answer is layered:
+
+1. per-op micro-jit cache (ops/registry.py bind) — steady-state eager
+   dispatch is a dict hit + one XLA async dispatch,
+2. CachedOp / hybridize — a whole Block traces into ONE XLA program
+   (the segment-level bulking the reference built by hand),
+3. DataParallelTrainer.step_multi — K whole train steps scanned into one
+   launch.
+
+plus the transparent ``mx.engine.bulk`` scope (engine.py) — the direct
+BulkAppend analogue: unmodified eager code inside the scope is deferred
+and replayed as one cached XLA program.
+
+This script quantifies all three on the current backend: a chain of
+small elementwise ops (the reference's worst case) run eagerly op-by-op,
+the same loop inside ``engine.bulk``, and the chain as one hybridized
+CachedOp.  Prints ONE JSON line with ops/sec for each.
+"""
+import json
+import time
+
+import numpy as np
+
+
+CHAIN = 64          # ops per iteration (a*b+c, relu, sum-free chain)
+ITERS = 30
+SHAPE = (64, 64)
+
+
+def _chain_eager(a, b, c, n):
+    for _ in range(n // 4):
+        a = a * b
+        a = a + c
+        a = a.abs()
+        a = a - c
+    return a
+
+
+def main():
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+
+    backend = jax.default_backend()
+    rs = np.random.RandomState(0)
+    a = mx.nd.array(rs.rand(*SHAPE).astype(np.float32))
+    b = mx.nd.array(rs.rand(*SHAPE).astype(np.float32) + 0.5)
+    c = mx.nd.array(rs.rand(*SHAPE).astype(np.float32))
+
+    # warmup (fills the per-op jit caches)
+    _chain_eager(a, b, c, CHAIN).asnumpy()
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = _chain_eager(a, b, c, CHAIN)
+    out.asnumpy()                       # sync
+    dt_eager = time.perf_counter() - t0
+    eager_ops = CHAIN * ITERS / dt_eager
+
+    # engine bulking: same eager code, deferred + replayed as ONE program
+    # (sync once at the end, like the eager loop above)
+    with mx.engine.bulk(CHAIN + 1):
+        _chain_eager(a, b, c, CHAIN).asnumpy()      # compile the replay
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        with mx.engine.bulk(CHAIN + 1):
+            out = _chain_eager(a, b, c, CHAIN)
+    out.asnumpy()
+    dt_bulkscope = time.perf_counter() - t0
+    bulkscope_ops = CHAIN * ITERS / dt_bulkscope
+
+    class Chain(gluon.HybridBlock):
+        def hybrid_forward(self, F, a, b, c):
+            for _ in range(CHAIN // 4):
+                a = a * b
+                a = a + c
+                a = F.abs(a)
+                a = a - c
+            return a
+
+    blk = Chain()
+    blk.hybridize()
+    blk(a, b, c).asnumpy()              # trace + compile
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = blk(a, b, c)
+    out.asnumpy()
+    dt_bulk = time.perf_counter() - t0
+    bulk_ops = CHAIN * ITERS / dt_bulk
+
+    print(json.dumps({
+        "metric": "eager_small_op_dispatch",
+        "backend": backend,
+        "chain_len": CHAIN,
+        "eager_ops_per_sec": round(eager_ops, 1),
+        "engine_bulk_ops_per_sec": round(bulkscope_ops, 1),
+        "hybridized_ops_per_sec": round(bulk_ops, 1),
+        "engine_bulk_speedup": round(bulkscope_ops / eager_ops, 2),
+        "hybridize_speedup": round(bulk_ops / eager_ops, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

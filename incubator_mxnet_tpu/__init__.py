@@ -23,6 +23,7 @@ from . import ndarray as nd  # canonical alias, as in mxnet
 from .ndarray import NDArray
 
 from . import autograd
+from . import engine
 from . import random
 from . import random_state
 

@@ -1,0 +1,211 @@
+"""Engine op-bulking: defer eager ops, replay them as ONE XLA program.
+
+The reference's engine amortized per-op push overhead by appending
+consecutive eager ops into a bulk segment executed as one engine op
+(src/engine/threaded_engine.h:472-509 BulkAppend/BulkFlush,
+MXNET_EXEC_BULK_EXEC_*).  The TPU-native analogue: inside a
+
+    with mx.engine.bulk(64):
+        for ...:
+            eager small ops
+
+scope, pure eager op invocations are RECORDED instead of dispatched; the
+pending program is flushed — compiled (once, cached by program shape) and
+executed as a single jitted replay — when the scope closes, the segment
+reaches ``size`` ops, or any deferred value is materialized (asnumpy,
+_read, in-place write, autograd capture).  Steady-state loops hit the
+replay cache, so N small ops cost one dispatch (measured ~5x on the
+eager micro-benchmark, bench_eager.py).
+
+Out of scope for deferral (dispatched eagerly, exactly as before):
+autograd-recording ops (the tape takes jax.vjp at invoke), ``out=``
+stores, mutating ops (optimizer updates), sparse storage, ops that
+manage their own mesh placement (no_jit), and NaiveEngine mode.  VIEW
+creation (reshape/slice) over a deferred value materializes it — views
+share storage with their base, which must be concrete for write-through;
+keep chains view-free for maximal segments.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["bulk", "flush"]
+
+
+class _Pending(object):
+    """Placeholder for a deferred value (knows shape/dtype for metadata
+    queries; ``value`` is filled at flush).  ``owners`` holds weakrefs to
+    the NDArrays exposing this value: a pending with no live owner at
+    flush time is dead (an intermediate the chain rebound) and is NOT
+    returned from the replay program — dead-value elimination keeps the
+    per-flush output count at what the user actually kept."""
+    __slots__ = ("shape", "dtype", "slot", "value", "state", "epoch",
+                 "owners", "__weakref__")
+
+    def __init__(self, shape, dtype, slot, state):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.slot = slot
+        self.value = None
+        self.state = state
+        self.epoch = state.epoch
+        self.owners = []
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+
+class _BulkState(object):
+    def __init__(self, size):
+        self.size = size
+        self.epoch = 0           # bumped per flush: "t" refs are only
+        #                          valid within their own segment
+        self.instructions = []   # (op_name, params, pkey, is_train,
+        #                           in_refs, rng_slot, n_out)
+        self.ext = []            # concrete jax operands (program inputs)
+        self.pendings = []       # _Pending objects in slot order
+
+    def add_ext(self, v):
+        self.ext.append(v)
+        return len(self.ext) - 1
+
+
+_tls = threading.local()
+_replay_cache = {}
+_infer_cache = {}   # (op, input sig, params, train) -> output sig; shape
+# inference via jax.eval_shape costs ~a dispatch itself, so recording
+# would be slower than executing without this memo
+
+
+def _current():
+    return getattr(_tls, "state", None)
+
+
+class bulk(object):
+    """Context manager: defer up to ``size`` eager ops per segment."""
+
+    def __init__(self, size=64):
+        self.size = max(int(size), 1)
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = _current()
+        _tls.state = _BulkState(self.size)
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            flush()
+        finally:
+            _tls.state = self._prev
+
+
+def maybe_defer(op, params, vals, is_train, kw):
+    """Called from the eager invoke: record the op if a bulk scope is
+    active and every input is deferrable.  Returns a tuple of _Pending
+    outputs, or None to dispatch eagerly."""
+    st = _current()
+    if st is None:
+        return None
+    if len(st.instructions) >= st.size:
+        # flush BEFORE recording the next op (never right after one: the
+        # freshly created outputs get their owner refs only once invoke
+        # wraps them — flushing in between would mis-classify them dead)
+        flush()
+    from .ops.registry import _hashable
+    in_refs = []
+    shapes = []
+    for v in vals:
+        if type(v) is _Pending:
+            if v.state is not st or v.epoch != st.epoch:
+                return None       # cross-scope/segment value: materialize
+            in_refs.append(("t", v.slot))
+        else:
+            in_refs.append(("e", st.add_ext(v)))
+        shapes.append((tuple(v.shape), str(v.dtype)))
+    rng_slot = st.add_ext(kw["rng"]) if "rng" in kw else None
+    pkey = _hashable(params)
+    ikey = (op.name, tuple(shapes), pkey, bool(is_train))
+    out_sig = _infer_cache.get(ikey)
+    if out_sig is None:
+        try:
+            out_sig = op.infer(shapes, params, is_train)
+        except Exception:
+            return None           # shape inference failed: run eagerly
+        _infer_cache[ikey] = out_sig
+    outs = []
+    for shp, dt in out_sig:
+        p = _Pending(shp, dt, len(st.pendings), st)
+        st.pendings.append(p)
+        outs.append(p)
+    st.instructions.append((op.name, dict(params), pkey,
+                            bool(is_train), tuple(in_refs), rng_slot,
+                            len(outs)))
+    return tuple(outs)
+
+
+def resolve(pending):
+    """Materialize one deferred value (flushes its segment if needed)."""
+    if pending.value is None:
+        flush(pending.state)
+    if pending.value is None:  # liveness tracking invariant violated
+        raise RuntimeError("bulk engine: deferred value was eliminated as "
+                           "dead but later read — please report")
+    return pending.value
+
+
+def flush(state=None):
+    """Compile (cached) + run the pending segment; fill every _Pending."""
+    st = state if state is not None else _current()
+    if st is None or not st.instructions:
+        return
+    instrs = st.instructions
+    ext = st.ext
+    pendings = st.pendings
+    # reset the scope so new ops start a fresh segment (and so re-entrant
+    # flushes from _read during execution see an empty program)
+    st.instructions, st.ext, st.pendings = [], [], []
+    st.epoch += 1
+
+    # only values still exposed through a live NDArray leave the program
+    live = tuple(i for i, p in enumerate(pendings)
+                 if any(w() is not None for w in p.owners))
+    key = (tuple((name, pkey, train, in_refs, rng_slot, n_out)
+                 for name, _p, pkey, train, in_refs, rng_slot, n_out
+                 in instrs),
+           tuple((tuple(v.shape), str(v.dtype)) for v in ext),
+           live)
+    fn = _replay_cache.get(key)
+    if fn is None:
+        from .ops.registry import get_op
+        plan = [(get_op(name).raw(p, train), in_refs, rng_slot, n_out)
+                for name, p, _k, train, in_refs, rng_slot, n_out in instrs]
+
+        def replay(ext_vals):
+            tmp = []
+            for raw, in_refs, rng_slot, n_out in plan:
+                args = [ext_vals[i] if tag == "e" else tmp[i]
+                        for tag, i in in_refs]
+                kw = {"rng": ext_vals[rng_slot]} if rng_slot is not None \
+                    else {}
+                res = raw(*args, **kw)
+                if not isinstance(res, tuple):
+                    res = (res,)
+                tmp.extend(res)
+            return tuple(tmp[i] for i in live)
+
+        fn = jax.jit(replay)
+        _replay_cache[key] = fn
+    results = fn(ext)
+    for i, v in zip(live, results):
+        pendings[i].value = v

@@ -84,14 +84,28 @@ class NDArray:
     def _read(self):
         """Current jax.Array value (no host sync)."""
         if self._base is None:
+            if type(self._data) is _engine_mod()._Pending:
+                self._data = _engine_mod().resolve(self._data)
             return self._data
         b = self._base
+        if type(b._data) is _engine_mod()._Pending:
+            b._data = _engine_mod().resolve(b._data)
         if self._cache_version != b._version or self._data is None:
             flat = b._data.reshape((-1,))
             size = int(np.prod(self._shape)) if self._shape else 1
             self._data = jax.lax.slice(flat, (self._offset,), (self._offset + size,)).reshape(self._shape)
             self._cache_version = b._version
         return self._data
+
+    def _read_deferred(self):
+        """Like _read, but inside an active bulk scope an unresolved
+        deferred value is returned as its _Pending placeholder so op
+        chains keep deferring (engine.py maybe_defer)."""
+        d = self._data
+        if (self._base is None and type(d) is _engine_mod()._Pending
+                and d.value is None):
+            return d
+        return self._read()
 
     def _write(self, value):
         """Replace contents (in-place semantics; bumps the version 'var')."""
@@ -100,6 +114,8 @@ class NDArray:
             self._version += 1
         else:
             b = self._base
+            if type(b._data) is _engine_mod()._Pending:
+                b._data = _engine_mod().resolve(b._data)
             size = int(np.prod(self._shape)) if self._shape else 1
             flat = b._data.reshape((-1,))
             flat = jax.lax.dynamic_update_slice(flat, value.reshape((-1,)).astype(b._data.dtype),
@@ -124,6 +140,12 @@ class NDArray:
 
     @property
     def dtype(self):
+        d = self._root()._data
+        if d is not None:
+            # the root's buffer answers for views too, and works for
+            # concrete arrays AND deferred placeholders — metadata
+            # queries must not force a bulk flush
+            return np.dtype(d.dtype)
         return np.dtype(self._read().dtype)
 
     @property
@@ -510,6 +532,17 @@ def _call(name, *args, **kwargs):
     return getattr(_reg.module_surface, name)(*args, **kwargs)
 
 
+_ENGINE = None
+
+
+def _engine_mod():
+    global _ENGINE
+    if _ENGINE is None:
+        from .. import engine
+        _ENGINE = engine
+    return _ENGINE
+
+
 # ---------------------------------------------------------------------------
 # eager op invocation (the imperative runtime; ref: src/imperative/imperative.cc)
 # ---------------------------------------------------------------------------
@@ -526,13 +559,35 @@ def invoke(op: Operator, inputs, params, out=None):
     params = {k: v for k, v in params.items() if v is not None or k in ("axis",)}
     ctx_override = params.pop("ctx", None)
     params.pop("name", None)
-    vals = [a._read() for a in inputs]
     is_train = autograd.is_training()
     recording = autograd.is_recording() and op.differentiable
 
+    # engine bulking (threaded_engine.h BulkAppend reborn): inside a
+    # `with mx.engine.bulk()` scope, pure eager ops are recorded and later
+    # replayed as ONE jitted program instead of dispatched one by one
     kw = {}
     if op.needs_rng:
         kw["rng"] = random_state.next_key()
+
+    _eng = _engine_mod()
+    if (_eng._current() is not None and not recording and out is None
+            and ctx_override is None and not op.mutate_inputs
+            and not _NAIVE_ENGINE and not getattr(op, "no_jit", False)):
+        vals = [a._read_deferred() for a in inputs]
+        pend = _eng.maybe_defer(op, params, vals, is_train, kw)
+        if pend is not None:
+            import weakref
+            ctx = inputs[0]._ctx if inputs else current_context()
+            out_arrays = []
+            for p in pend:
+                nd_out = NDArray(p, ctx=ctx)
+                p.owners.append(weakref.ref(nd_out))
+                out_arrays.append(nd_out)
+            n_vis = op.visible_outputs(params, len(out_arrays))
+            visible = out_arrays[:n_vis]
+            return visible[0] if len(visible) == 1 else visible
+
+    vals = [a._read() for a in inputs]
 
     from .. import profiler as _profiler
     _span = _profiler.op_span(op.name, "imperative")

@@ -367,10 +367,12 @@ class DataParallelTrainer(object):
         """Run K chained steps in one launch; ``datas`` (K, batch, ...),
         ``labels`` (K, batch).  Returns the last step's device loss."""
         from .mesh import use_mesh
-        xs, ys = self._prepare_inputs(datas, labels, P(None, "dp"),
-                                      multi=True)
-        fn = self.compile_multi(xs, ys)
         with use_mesh(self.mesh):
+            # scope covers deferred-init (in _prepare_inputs) AND the
+            # trace: mesh-aware layers resolve this mesh throughout
+            xs, ys = self._prepare_inputs(datas, labels, P(None, "dp"),
+                                          multi=True)
+            fn = self.compile_multi(xs, ys)
             self._params, self._opt_state, self._rng_key, loss_val = fn(
                 self._params, self._opt_state, self._rng_key, xs, ys,
                 self._lr_dev)
@@ -383,9 +385,11 @@ class DataParallelTrainer(object):
         mesh-aware layers (MultiHeadAttention(seq_axis=...), capacity MoE)
         resolve THIS mesh without the caller wrapping every step."""
         from .mesh import use_mesh
-        x, y = self._prepare_inputs(data, label, P("dp"))
-        fn = self.compile(x, y)
         with use_mesh(self.mesh):
+            # scope covers deferred-init (in _prepare_inputs) AND the
+            # trace: mesh-aware layers resolve this mesh throughout
+            x, y = self._prepare_inputs(data, label, P("dp"))
+            fn = self.compile(x, y)
             self._params, self._opt_state, self._rng_key, loss_val = fn(
                 self._params, self._opt_state, self._rng_key, x, y,
                 self._lr_dev)

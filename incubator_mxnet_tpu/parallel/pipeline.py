@@ -231,7 +231,11 @@ class PipelineTrainer(object):
         pv = probe._read()
         names = list(template.collect_params().keys())
         for blk, vals in zip(self._stages[1:], stage_vals[1:]):
-            own = np.asarray(blk(NDArray(pv))._read())
+            # both sides run through _run_block (same train mode), else a
+            # training-sensitive layer (BatchNorm) would falsely differ
+            own_names = list(blk.collect_params().keys())
+            own = np.asarray(
+                _run_block(blk, dict(zip(own_names, vals)), pv))
             via_tmpl = np.asarray(
                 _run_block(template, dict(zip(names, vals)), pv))
             if not np.allclose(own, via_tmpl, rtol=1e-5, atol=1e-6):

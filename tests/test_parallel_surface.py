@@ -199,3 +199,28 @@ def test_pipeline_trainer_rejects_divergent_stage_compute():
     X = np.random.RandomState(0).randn(4, 4).astype(np.float32)
     with pytest.raises(ValueError, match="computes differently"):
         tr.step(X, X)
+
+
+def test_pipeline_trainer_batchnorm_stages():
+    """Training-mode-sensitive layers (BatchNorm) in identical stages must
+    pass the stage-equivalence probe (review regression: the probe once
+    compared train-mode vs inference-mode outputs)."""
+    class Stage(nn.HybridSequential):
+        def __init__(self):
+            super().__init__()
+            self.add(nn.Dense(6, flatten=False, in_units=6))
+            self.add(nn.BatchNorm(axis=-1, in_channels=6))
+            self.add(nn.Activation("tanh"))
+
+    mesh = make_mesh({"pp": 2}, jax.devices("cpu")[:2])
+    mx.random.seed(13)
+    body = nn.HybridSequential()
+    for _ in range(2):
+        s = Stage()
+        s.initialize(mx.init.Xavier())
+        body.add(s)
+    tr = PipelineTrainer(body, gluon.loss.L2Loss(), mesh,
+                         num_microbatches=2, learning_rate=0.05)
+    X = np.random.RandomState(1).randn(4, 6).astype(np.float32)
+    losses = [float(np.asarray(tr.step(X, X))) for _ in range(5)]
+    assert all(np.isfinite(l) for l in losses), losses
