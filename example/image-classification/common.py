@@ -1,0 +1,128 @@
+"""Shared fit plumbing for the image-classification examples.
+
+Plays the role of the reference's example/image-classification/common/fit.py
+(argument surface, kvstore wiring, lr schedule), rebuilt for this
+framework's surfaces: Module.fit, the Gluon Trainer loop, and the fused
+DataParallelTrainer.
+"""
+import argparse
+import logging
+import time
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+
+
+def add_fit_args(parser):
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--wd", type=float, default=1e-4)
+    parser.add_argument("--optimizer", default="sgd")
+    parser.add_argument("--kv-store", default="local",
+                        help="local | device | dist_sync")
+    parser.add_argument("--disp-batches", type=int, default=20)
+    parser.add_argument("--seed", type=int, default=42)
+    return parser
+
+
+def fit_module(symbol, train_iter, val_iter, args):
+    """Train through the Module API (ref: base_module.py fit)."""
+    kv = mx.kv.create(args.kv_store)
+    mod = mx.mod.Module(symbol, context=mx.context.Context.default_ctx())
+    batch_end = mx.callback.Speedometer(args.batch_size, args.disp_batches)
+    mod.fit(train_iter,
+            eval_data=val_iter,
+            num_epoch=args.num_epochs,
+            kvstore=kv,
+            optimizer=args.optimizer,
+            optimizer_params={"learning_rate": args.lr,
+                              "momentum": args.momentum, "wd": args.wd},
+            initializer=mx.init.Xavier(magnitude=2.0),
+            batch_end_callback=batch_end,
+            eval_metric="acc")
+    score = mod.score(val_iter, "acc")
+    for name, val in score:
+        logging.info("final validation %s=%f", name, val)
+    return dict(score)["accuracy"]
+
+
+def fit_gluon(net, train_iter, val_iter, args):
+    """Train the same workload through Gluon blocks + Trainer
+    (ref: gluon/trainer.py semantics)."""
+    kv = mx.kv.create(args.kv_store)
+    net.initialize(mx.init.Xavier(magnitude=2.0))
+    trainer = gluon.Trainer(net.collect_params(), args.optimizer,
+                            {"learning_rate": args.lr,
+                             "momentum": args.momentum, "wd": args.wd},
+                            kvstore=kv)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    from incubator_mxnet_tpu import autograd
+    for epoch in range(args.num_epochs):
+        train_iter.reset()
+        tic = time.time()
+        n = 0
+        for i, batch in enumerate(train_iter):
+            x, y = batch.data[0], batch.label[0]
+            if x.dtype == np.uint8:   # raw-record pipeline: cast on use
+                x = x.astype("float32")
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(x.shape[0] * max(kv.num_workers, 1))
+            n += x.shape[0]
+            if args.disp_batches and (i + 1) % args.disp_batches == 0:
+                logging.info("epoch %d batch %d speed %.1f samples/s",
+                             epoch, i + 1, n / (time.time() - tic))
+        logging.info("epoch %d done in %.1fs", epoch, time.time() - tic)
+    return evaluate_gluon(net, val_iter)
+
+
+def evaluate_gluon(net, val_iter):
+    val_iter.reset()
+    correct = total = 0
+    for batch in val_iter:
+        x = batch.data[0]
+        if x.dtype == np.uint8:
+            x = x.astype("float32")
+        out = net(x).asnumpy()
+        y = batch.label[0].asnumpy()
+        keep = len(y) - batch.pad
+        correct += (out.argmax(1)[:keep] == y[:keep]).sum()
+        total += keep
+    acc = correct / max(total, 1)
+    logging.info("final validation accuracy=%f", acc)
+    return acc
+
+
+def fit_fused(net, train_iter, val_iter, args, dtype="bfloat16"):
+    """Train through the fused one-jit DataParallelTrainer — the TPU-first
+    fast path the bench uses (forward+loss+backward+update as ONE XLA
+    program, batch sharded over the mesh "dp" axis)."""
+    from incubator_mxnet_tpu.parallel import DataParallelTrainer
+    net.initialize(mx.init.Xavier(magnitude=2.0))
+    trainer = DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        optimizer=args.optimizer,
+        optimizer_params={"learning_rate": args.lr,
+                          "momentum": args.momentum, "wd": args.wd},
+        dtype=None if dtype in (None, "float32") else dtype)
+    for epoch in range(args.num_epochs):
+        train_iter.reset()
+        tic = time.time()
+        n = 0
+        loss = None
+        for i, batch in enumerate(train_iter):
+            loss = trainer.step(batch.data[0], batch.label[0])
+            n += batch.data[0].shape[0]
+            if args.disp_batches and (i + 1) % args.disp_batches == 0:
+                logging.info("epoch %d batch %d speed %.1f samples/s",
+                             epoch, i + 1, n / (time.time() - tic))
+        logging.info("epoch %d done in %.1fs (last loss %.4f)",
+                     epoch, time.time() - tic,
+                     float(np.asarray(loss)) if loss is not None else -1)
+    trainer.sync_params()
+    return evaluate_gluon(net, val_iter)

@@ -1,0 +1,157 @@
+"""Train an MLP or LeNet on MNIST — the reference's canonical first
+example (example/image-classification/train_mnist.py), rebuilt on this
+framework's surfaces.
+
+Data: real MNIST idx files when ``--data-dir`` points at them
+(train-images-idx3-ubyte[.gz] etc.); otherwise a deterministic synthetic
+stand-in with learnable class structure (this environment has no network
+egress), same shapes, same iterator API.
+
+Surfaces: default = Module.fit on the declarative Symbol graph;
+``--gluon`` = imperative Gluon blocks + Trainer.  Both support
+``--kv-store dist_sync`` under tools/launch.py for multi-process runs.
+
+Usage:
+    python train_mnist.py                     # Module, synthetic MNIST
+    python train_mnist.py --gluon --network lenet
+    python tools/launch.py -n 2 python train_mnist.py --kv-store dist_sync
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__))
+# make the in-repo package importable when run straight from a checkout
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+import common  # noqa: E402
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import gluon  # noqa: E402
+
+
+def load_mnist(data_dir, n_synth=4096):
+    """(train_x, train_y, val_x, val_y) — idx files or synthetic."""
+    import gzip
+    import struct
+
+    def read_idx(lbl, img):
+        def _open(p):
+            return gzip.open(p, "rb") if p.endswith(".gz") else open(p, "rb")
+        with _open(lbl) as f:
+            struct.unpack(">II", f.read(8))
+            y = np.frombuffer(f.read(), dtype=np.uint8)
+        with _open(img) as f:
+            struct.unpack(">IIII", f.read(16))
+            x = np.frombuffer(f.read(), dtype=np.uint8)
+        x = x.reshape(len(y), 1, 28, 28).astype(np.float32) / 255.0
+        return x, y.astype(np.float32)
+
+    if data_dir:
+        def find(stem):
+            for suf in ("", ".gz"):
+                p = os.path.join(data_dir, stem + suf)
+                if os.path.exists(p):
+                    return p
+            raise FileNotFoundError(stem)
+        tx, ty = read_idx(find("train-labels-idx1-ubyte"),
+                          find("train-images-idx3-ubyte"))
+        vx, vy = read_idx(find("t10k-labels-idx1-ubyte"),
+                          find("t10k-images-idx3-ubyte"))
+        return tx, ty, vx, vy
+
+    # synthetic: 10 class templates + noise — learnable, zero downloads
+    rs = np.random.RandomState(7)
+    templates = rs.rand(10, 1, 28, 28).astype(np.float32)
+    y = (rs.rand(n_synth) * 10).astype(np.int64)
+    x = templates[y] + 0.25 * rs.randn(n_synth, 1, 28, 28).astype(np.float32)
+    x = np.clip(x, 0.0, 1.0)
+    cut = int(n_synth * 0.9)
+    return (x[:cut], y[:cut].astype(np.float32),
+            x[cut:], y[cut:].astype(np.float32))
+
+
+def mlp_symbol():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc3")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def lenet_symbol():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(5, 5), num_filter=20)
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.Convolution(net, kernel=(5, 5), num_filter=50)
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=500)
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.FullyConnected(net, num_hidden=10)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def mlp_gluon():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Flatten(),
+            gluon.nn.Dense(128, activation="relu"),
+            gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(10))
+    return net
+
+
+def lenet_gluon():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(20, kernel_size=5, activation="tanh"),
+            gluon.nn.MaxPool2D(pool_size=2, strides=2),
+            gluon.nn.Conv2D(50, kernel_size=5, activation="tanh"),
+            gluon.nn.MaxPool2D(pool_size=2, strides=2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(500, activation="tanh"),
+            gluon.nn.Dense(10))
+    return net
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train mnist")
+    common.add_fit_args(parser)
+    parser.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
+    parser.add_argument("--gluon", action="store_true",
+                        help="train via Gluon blocks + Trainer")
+    parser.add_argument("--data-dir", default="",
+                        help="directory with MNIST idx files (synthetic "
+                             "fallback when empty)")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    if "dist" in args.kv_store:
+        # the coordination service must come up before ANY jax backend
+        # touch (the reference's DMLC_ROLE bootstrap, tools/launch.py)
+        from incubator_mxnet_tpu.parallel import dist
+        dist.init_process()
+    mx.random.seed(args.seed)
+
+    tx, ty, vx, vy = load_mnist(args.data_dir)
+    train_iter = mx.io.NDArrayIter(tx, ty, args.batch_size, shuffle=True,
+                                   label_name="softmax_label")
+    val_iter = mx.io.NDArrayIter(vx, vy, args.batch_size,
+                                 label_name="softmax_label")
+    if args.gluon:
+        net = lenet_gluon() if args.network == "lenet" else mlp_gluon()
+        net.hybridize()
+        acc = common.fit_gluon(net, train_iter, val_iter, args)
+    else:
+        sym = lenet_symbol() if args.network == "lenet" else mlp_symbol()
+        acc = common.fit_module(sym, train_iter, val_iter, args)
+    print("validation accuracy: %.4f" % acc)
+
+
+if __name__ == "__main__":
+    main()

@@ -42,7 +42,7 @@ class _Pending(object):
     returned from the replay program — dead-value elimination keeps the
     per-flush output count at what the user actually kept."""
     __slots__ = ("shape", "dtype", "slot", "value", "state", "epoch",
-                 "owners", "__weakref__")
+                 "owners", "error", "__weakref__")
 
     def __init__(self, shape, dtype, slot, state):
         self.shape = tuple(shape)
@@ -52,6 +52,7 @@ class _Pending(object):
         self.state = state
         self.epoch = state.epoch
         self.owners = []
+        self.error = None
 
     @property
     def ndim(self):
@@ -73,11 +74,16 @@ class _BulkState(object):
         self.instructions = []   # (op_name, params, pkey, is_train,
         #                           in_refs, rng_slot, n_out)
         self.ext = []            # concrete jax operands (program inputs)
+        self.ext_ids = {}        # id(array) -> slot (identity dedup)
         self.pendings = []       # _Pending objects in slot order
 
     def add_ext(self, v):
-        self.ext.append(v)
-        return len(self.ext) - 1
+        slot = self.ext_ids.get(id(v))
+        if slot is None:
+            self.ext.append(v)
+            slot = len(self.ext) - 1
+            self.ext_ids[id(v)] = slot
+        return slot
 
 
 _tls = threading.local()
@@ -123,17 +129,19 @@ def maybe_defer(op, params, vals, is_train, kw):
         # wraps them — flushing in between would mis-classify them dead)
         flush()
     from .ops.registry import _hashable
-    in_refs = []
+    # stage input refs WITHOUT touching st yet: if we bail (stale
+    # pending, failed inference) no orphan ext entries may pollute the
+    # replay-cache key
+    staged = []
     shapes = []
     for v in vals:
         if type(v) is _Pending:
             if v.state is not st or v.epoch != st.epoch:
                 return None       # cross-scope/segment value: materialize
-            in_refs.append(("t", v.slot))
+            staged.append(("t", v))
         else:
-            in_refs.append(("e", st.add_ext(v)))
+            staged.append(("e", v))
         shapes.append((tuple(v.shape), str(v.dtype)))
-    rng_slot = st.add_ext(kw["rng"]) if "rng" in kw else None
     pkey = _hashable(params)
     ikey = (op.name, tuple(shapes), pkey, bool(is_train))
     out_sig = _infer_cache.get(ikey)
@@ -143,6 +151,9 @@ def maybe_defer(op, params, vals, is_train, kw):
         except Exception:
             return None           # shape inference failed: run eagerly
         _infer_cache[ikey] = out_sig
+    in_refs = [(tag, v.slot if tag == "t" else st.add_ext(v))
+               for tag, v in staged]
+    rng_slot = st.add_ext(kw["rng"]) if "rng" in kw else None
     outs = []
     for shp, dt in out_sig:
         p = _Pending(shp, dt, len(st.pendings), st)
@@ -158,6 +169,9 @@ def resolve(pending):
     """Materialize one deferred value (flushes its segment if needed)."""
     if pending.value is None:
         flush(pending.state)
+    if pending.error is not None:
+        raise RuntimeError("bulk engine: the deferred segment holding this "
+                           "value failed to execute") from pending.error
     if pending.value is None:  # liveness tracking invariant violated
         raise RuntimeError("bulk engine: deferred value was eliminated as "
                            "dead but later read — please report")
@@ -175,6 +189,7 @@ def flush(state=None):
     # reset the scope so new ops start a fresh segment (and so re-entrant
     # flushes from _read during execution see an empty program)
     st.instructions, st.ext, st.pendings = [], [], []
+    st.ext_ids = {}
     st.epoch += 1
 
     # only values still exposed through a live NDArray leave the program
@@ -206,6 +221,22 @@ def flush(state=None):
 
         fn = jax.jit(replay)
         _replay_cache[key] = fn
-    results = fn(ext)
+    try:
+        results = fn(ext)
+    except Exception as exc:
+        # stamp every pending with the real cause: later reads raise THIS
+        # instead of a misleading liveness error
+        for p in pendings:
+            p.error = exc
+        raise
     for i, v in zip(live, results):
         pendings[i].value = v
+    if results:
+        # nd.waitall()'s WaitForAll contract covers bulk dispatches too
+        from .ndarray import ndarray as _nd
+        devs = getattr(results[0], "devices", None)
+        if devs is not None:
+            try:
+                _nd._DISPATCH_DEVICES.update(devs())
+            except Exception:
+                pass

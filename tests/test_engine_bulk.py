@@ -103,3 +103,30 @@ def test_bulk_mixed_with_views():
         v = y[1]           # view of a deferred value: materializes base
         got = v.asnumpy()
     np.testing.assert_allclose(got, np.arange(4, 8, dtype=np.float32) * 2)
+
+
+def test_bulk_waitall_covers_replay():
+    """nd.waitall() must drain bulk-replayed dispatches too (WaitForAll
+    contract, review regression)."""
+    from incubator_mxnet_tpu.ndarray import ndarray as nd_mod
+    nd_mod._DISPATCH_DEVICES.clear()
+    a = nd.array(np.ones((8, 8), np.float32))
+    with engine.bulk(16):
+        out = a * 3 + 1
+    assert len(nd_mod._DISPATCH_DEVICES) > 0
+    nd.waitall()
+    np.testing.assert_allclose(out.asnumpy(), 4.0)
+
+
+def test_bulk_ext_dedup():
+    """Repeated operands enter the replay program once (identity dedup)."""
+    a = nd.array(np.ones((4, 4), np.float32))
+    b = nd.array(np.ones((4, 4), np.float32) * 2)
+    with engine.bulk(32) as scope:
+        y = a * b
+        z = y + b      # b reused
+        w = z * b      # and again
+        st = engine._current()
+        assert len(st.ext) == 2, st.ext   # a and b only
+        got = w.asnumpy()
+    np.testing.assert_allclose(got, (1 * 2 + 2) * 2)

@@ -1,0 +1,54 @@
+"""The example/ scripts must stay runnable (the reference treats its
+example tree as its proof of usability — README.md: train_mnist /
+train_imagenet are the scripts behind every BASELINE number)."""
+import os
+import runpy
+import sys
+
+import pytest
+
+_DIR = os.path.join(os.path.dirname(__file__), "..", "example",
+                    "image-classification")
+
+
+def _run(script, argv):
+    old = sys.argv
+    sys.argv = [script] + argv
+    sys.path.insert(0, _DIR)
+    try:
+        runpy.run_path(os.path.join(_DIR, script), run_name="__main__")
+    except SystemExit as e:
+        assert not e.code, e.code
+    finally:
+        sys.argv = old
+        sys.path.remove(_DIR)
+
+
+def test_train_mnist_module(capsys):
+    _run("train_mnist.py", ["--num-epochs", "2", "--batch-size", "256",
+                            "--disp-batches", "0"])
+    out = capsys.readouterr().out
+    acc = float(out.strip().rsplit(" ", 1)[-1])
+    assert acc > 0.9, out
+
+
+def test_train_mnist_gluon(capsys):
+    _run("train_mnist.py", ["--gluon", "--num-epochs", "2",
+                            "--batch-size", "256", "--disp-batches", "0"])
+    out = capsys.readouterr().out
+    acc = float(out.strip().rsplit(" ", 1)[-1])
+    assert acc > 0.9, out
+
+
+@pytest.mark.parametrize("surface", ["fused", "module"])
+def test_train_imagenet_smoke(capsys, surface):
+    argv = ["--network", "resnet18", "--image-shape", "3,32,32",
+            "--num-classes", "4", "--batch-size", "16", "--num-batches",
+            "3", "--num-epochs", "1", "--disp-batches", "0"]
+    if surface == "module":
+        argv.append("--module")
+    else:
+        argv += ["--dtype", "float32"]
+    _run("train_imagenet.py", argv)
+    out = capsys.readouterr().out
+    assert "validation accuracy" in out
