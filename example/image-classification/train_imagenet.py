@@ -48,7 +48,8 @@ NETWORKS = {
 
 
 def synthetic_iters(args, shape):
-    """ImageNet-shaped random batches with class-dependent structure."""
+    """ImageNet-shaped random batches with class-dependent structure
+    (rank-sharded under dist kvstores)."""
     rs = np.random.RandomState(3)
     n = args.batch_size * args.num_batches
     y = (rs.rand(n) * args.num_classes).astype(np.int64)
@@ -63,7 +64,12 @@ def synthetic_iters(args, shape):
         vx, vy = x, y
     else:
         vx, vy = x[cut:], y[cut:]
-    train = mx.io.NDArrayIter(x[:cut], y[:cut].astype(np.float32),
+    tx, ty = x[:cut], y[:cut]
+    if "dist" in args.kv_store:
+        from incubator_mxnet_tpu.parallel import dist
+        tx, ty = tx[dist.rank()::dist.num_workers()], \
+            ty[dist.rank()::dist.num_workers()]
+    train = mx.io.NDArrayIter(tx, ty.astype(np.float32),
                               args.batch_size, shuffle=True,
                               label_name="softmax_label")
     val = mx.io.NDArrayIter(vx, vy.astype(np.float32),
@@ -73,11 +79,16 @@ def synthetic_iters(args, shape):
 
 def record_iters(args, shape):
     """The real data plane: ImageRecordIter over .rec (+ .idx)."""
+    rank, nw = 0, 1
+    if "dist" in args.kv_store:
+        from incubator_mxnet_tpu.parallel import dist
+        rank, nw = dist.rank(), dist.num_workers()
     train = mx.io.ImageRecordIter(
         path_imgrec=args.data_rec + ".rec",
         path_imgidx=args.data_rec + ".idx",
         data_shape=tuple(shape), batch_size=args.batch_size,
         shuffle=True, dtype="uint8", aug_list=[],
+        part_index=rank, num_parts=nw,     # rank-sharded, like the ref
         preprocess_threads=args.preprocess_threads,
         prefetch_buffer=args.prefetch_buffer, ctx=mx.cpu(0))
     val_rec = args.data_rec_val or args.data_rec

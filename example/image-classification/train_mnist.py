@@ -139,6 +139,12 @@ def main():
     mx.random.seed(args.seed)
 
     tx, ty, vx, vy = load_mnist(args.data_dir)
+    if "dist" in args.kv_store:
+        # shard the training set by worker rank (the reference's
+        # part_index/num_parts split) — no redundant compute across ranks
+        from incubator_mxnet_tpu.parallel import dist
+        tx, ty = tx[dist.rank()::dist.num_workers()], \
+            ty[dist.rank()::dist.num_workers()]
     train_iter = mx.io.NDArrayIter(tx, ty, args.batch_size, shuffle=True,
                                    label_name="softmax_label")
     val_iter = mx.io.NDArrayIter(vx, vy, args.batch_size,

@@ -41,7 +41,7 @@ class _ProfilerState:
         self.filename = "profile.json"
         self.profile_imperative = True
         self.profile_symbolic = True
-        self.profile_memory = True
+        self.profile_memory = False  # reference default: opt-in (docs/faq/env_var.md profile options)
         self.profile_api = True
         self.aggregate_stats = False
         self.sync = False
@@ -171,15 +171,83 @@ def dump(finished=True):
     return _P.filename
 
 
+_sampled_peak = {}   # device -> max live bytes seen by the fallback
+
+
+def device_memory():
+    """Per-device memory statistics — the storage-manager accounting of
+    SURVEY §2.1 (ref: src/profiler/storage_profiler.h hooked at
+    storage.cc:77-79; here the XLA per-device allocator IS the storage
+    manager).  Primary source: ``Device.memory_stats()`` (real TPU
+    runtimes report allocator counters incl. true peak).  Backends that
+    report nothing (host CPU, tunneled devices) fall back to summing
+    ``jax.live_arrays()`` shards per device — exact live bytes, with
+    ``peak_bytes_in_use`` the max live bytes ever *sampled* by this
+    function (``source`` says which accounting answered)."""
+    import jax
+    out = []
+    for d in jax.local_devices():
+        stats = d.memory_stats() or {}
+        if stats:
+            out.append({
+                "device": str(d),
+                "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
+                "bytes_limit": int(stats.get("bytes_limit", 0)),
+                "num_allocs": int(stats.get("num_allocs", 0)),
+                "source": "allocator",
+            })
+        else:
+            out.append({"device": str(d), "bytes_in_use": 0,
+                        "peak_bytes_in_use": 0, "bytes_limit": 0,
+                        "num_allocs": 0, "source": "live_arrays"})
+    fallback = {m["device"]: m for m in out if m["source"] == "live_arrays"}
+    if fallback:
+        for arr in jax.live_arrays():
+            try:
+                shards = arr.addressable_shards
+            except Exception:
+                continue
+            for sh in shards:
+                m = fallback.get(str(sh.device))
+                if m is not None:
+                    m["bytes_in_use"] += int(sh.data.nbytes)
+                    m["num_allocs"] += 1
+        for dev, m in fallback.items():
+            peak = max(_sampled_peak.get(dev, 0), m["bytes_in_use"])
+            _sampled_peak[dev] = peak
+            m["peak_bytes_in_use"] = peak
+    return out
+
+
+def record_memory_snapshot(name="device_memory"):
+    """Append chrome-trace counter events ("C" phase) with each device's
+    live bytes — storage_profiler's counter stream for the trace view."""
+    if not _P.active():
+        return
+    ts = _now_us()
+    with _lock:
+        for m in device_memory():
+            _P.events.append({
+                "name": name, "cat": "memory", "ph": "C", "ts": ts,
+                "pid": m["device"],
+                "args": {"bytes_in_use": m["bytes_in_use"],
+                         "peak_bytes_in_use": m["peak_bytes_in_use"]},
+            })
+
+
 def dumps(reset=False):
     """Aggregate per-op statistics table (ref: aggregate_stats.cc /
-    MXAggregateProfileStatsPrint; python profiler.py dumps:127)."""
+    MXAggregateProfileStatsPrint; python profiler.py dumps:127), plus a
+    per-device memory section when ``profile_memory`` is configured."""
     with _lock:
         events = list(_P.events)
         if reset:
             _P.events = []
     stats = {}
     for ev in events:
+        if "dur" not in ev:
+            continue   # counter ("C") / instant ("i") events have no span
         s = stats.setdefault((ev["cat"], ev["name"]),
                              [0, 0.0, float("inf"), 0.0])
         dur = ev["dur"]
@@ -193,6 +261,16 @@ def dumps(reset=False):
             stats.items(), key=lambda kv: -kv[1][1]):
         lines.append("%-32s %8d %12.1f %12.1f %12.1f %12.1f"
                      % (name[:32], cnt, tot, mn, mx, tot / cnt))
+    if _P.profile_memory:
+        lines.append("")
+        lines.append("%-24s %16s %16s %16s %12s"
+                     % ("Device memory", "InUse(bytes)", "Peak(bytes)",
+                        "Limit(bytes)", "Allocs"))
+        for m in device_memory():
+            lines.append("%-24s %16d %16d %16d %12d"
+                         % (m["device"][:24], m["bytes_in_use"],
+                            m["peak_bytes_in_use"], m["bytes_limit"],
+                            m["num_allocs"]))
     return "\n".join(lines)
 
 

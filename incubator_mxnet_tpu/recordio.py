@@ -26,6 +26,9 @@ __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
 _kMagic = 0xced7230a
 
 
+_MAX_REC_LEN = (1 << 29) - 1   # 29-bit length field (dmlc recordio)
+
+
 def _encode_lrec(cflag, length):
     return (cflag << 29) | length
 
@@ -109,13 +112,31 @@ class MXRecordIO(object):
             self.handle.write(data)     # framing done in C++
             return
         # dmlc recordio: no escaping needed for our write path because we
-        # write magic-aligned records with explicit length framing
-        self.handle.write(struct.pack("<II", _kMagic,
-                                      _encode_lrec(0, len(data))))
-        self.handle.write(data)
-        pad = (4 - len(data) % 4) % 4
-        if pad:
-            self.handle.write(b"\x00" * pad)
+        # write magic-aligned records with explicit length framing.
+        # Payloads that overflow the 29-bit length field split into
+        # begin(1)/middle(2)/end(3) parts (dmlc multi-part convention —
+        # the reader accumulates until cflag 0 or 3); a single chunk
+        # would silently bleed length bits into cflag
+        max_len = _MAX_REC_LEN
+
+        def emit(cflag, view):
+            self.handle.write(struct.pack("<II", _kMagic,
+                                          _encode_lrec(cflag, len(view))))
+            self.handle.write(view)
+            pad = (4 - len(view) % 4) % 4
+            if pad:
+                self.handle.write(b"\x00" * pad)
+
+        if len(data) <= max_len:
+            emit(0, data)
+            return
+        mv = memoryview(data)   # stream chunks, no payload copies
+        off = 0
+        while off < len(data):
+            n = min(max_len, len(data) - off)
+            cflag = 1 if off == 0 else (3 if off + n >= len(data) else 2)
+            emit(cflag, mv[off:off + n])
+            off += n
 
     def read(self):
         """Read one record, or None at EOF (ref: MXRecordIOReaderReadRecord;

@@ -111,14 +111,38 @@ uint64_t MXTPURecordIOWriterTell(void* handle) {
   return static_cast<uint64_t>(std::ftell(w->fp));
 }
 
-int MXTPURecordIOWriterWrite(void* handle, const char* data, uint64_t size) {
-  auto* w = static_cast<Writer*>(handle);
-  uint32_t head[2] = {kMagic, static_cast<uint32_t>(size)};  // cflag 0
-  if (std::fwrite(head, sizeof(uint32_t), 2, w->fp) != 2) return -1;
-  if (size && std::fwrite(data, 1, size, w->fp) != size) return -1;
+namespace {
+
+int write_chunk(FILE* fp, uint32_t cflag, const char* data, uint64_t size) {
+  uint32_t head[2] = {kMagic,
+                      (cflag << 29) | static_cast<uint32_t>(size)};
+  if (std::fwrite(head, sizeof(uint32_t), 2, fp) != 2) return -1;
+  if (size && std::fwrite(data, 1, size, fp) != size) return -1;
   uint32_t pad = (4 - size % 4) % 4;
   static const char zeros[4] = {0, 0, 0, 0};
-  if (pad && std::fwrite(zeros, 1, pad, w->fp) != pad) return -1;
+  if (pad && std::fwrite(zeros, 1, pad, fp) != pad) return -1;
+  return 0;
+}
+
+}  // namespace
+
+int MXTPURecordIOWriterWrite(void* handle, const char* data, uint64_t size) {
+  auto* w = static_cast<Writer*>(handle);
+  // payloads that overflow the 29-bit length field split into
+  // begin(1)/middle(2)/end(3) parts — the dmlc-core convention the
+  // reader's accumulate-until-cflag-0-or-3 loop already understands;
+  // a single-chunk write would silently corrupt the length into cflag
+  constexpr uint64_t kMaxLen = (1u << 29) - 1;
+  if (size <= kMaxLen) {
+    return write_chunk(w->fp, 0, data, size);
+  }
+  uint64_t off = 0;
+  while (off < size) {
+    uint64_t n = size - off < kMaxLen ? size - off : kMaxLen;
+    uint32_t cflag = off == 0 ? 1u : (off + n >= size ? 3u : 2u);
+    if (write_chunk(w->fp, cflag, data + off, n) != 0) return -1;
+    off += n;
+  }
   return 0;
 }
 

@@ -182,3 +182,39 @@ def test_uint8_with_augmenters_rejected(tmp_path):
                             data_shape=(3, 8, 8), batch_size=4,
                             dtype="uint8", mean_r=123.0,
                             preprocess_threads=1, prefetch_buffer=0)
+
+
+def test_multipart_record_roundtrip(tmp_path, monkeypatch):
+    """Payloads over the 29-bit length limit split into begin/middle/end
+    parts (dmlc convention) instead of silently corrupting the header —
+    readable by BOTH the python and native readers (ADVICE r02)."""
+    monkeypatch.setattr(recordio, "_MAX_REC_LEN", 100)  # force splitting
+    monkeypatch.setenv("MXTPU_NATIVE_IO", "0")  # python framing path
+    path = str(tmp_path / "multi.rec")
+    w = recordio.MXRecordIO(path, "w")
+    assert not w._native_handle
+    payloads = [b"x" * 10, b"y" * 321, b"z" * 100, b"w" * 205]
+    for pl in payloads:
+        w.write(pl)
+    w.close()
+
+    r = recordio.MXRecordIO(path, "r")
+    assert not r._native_handle
+    got = []
+    while True:
+        s = r.read()
+        if s is None:
+            break
+        got.append(bytes(s))
+    assert got == payloads
+
+    from incubator_mxnet_tpu import _native
+    if _native.available():
+        nr = _native.NativeRecordReader(path)
+        ngot = []
+        while True:
+            s = nr.read()
+            if s is None:
+                break
+            ngot.append(bytes(s))
+        assert ngot == payloads

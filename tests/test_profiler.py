@@ -99,3 +99,47 @@ def test_profiler_sync_mode(tmp_path):
     with open(fname) as f:
         events = json.load(f)["traceEvents"]
     assert any(e["name"] == "dot" for e in events)
+
+
+def test_device_memory_accounting():
+    """Per-device live/peak bytes in the aggregate table (SURVEY §2.1
+    storage accounting; ref: storage_profiler.h via storage.cc:77-79)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import profiler
+    mems = profiler.device_memory()
+    assert len(mems) >= 1
+    for m in mems:
+        for k in ("device", "bytes_in_use", "peak_bytes_in_use",
+                  "bytes_limit", "num_allocs", "source"):
+            assert k in m
+    # the accounting must SEE allocations (allocator counters on real
+    # TPU runtimes; live_arrays fallback elsewhere)
+    base = mems[0]["bytes_in_use"]
+    keep = mx.nd.zeros((1024, 1024))  # 4 MB on device 0
+    keep.asnumpy()
+    now = profiler.device_memory()[0]
+    assert now["bytes_in_use"] - base >= 4 * 1024 * 1024
+    assert now["peak_bytes_in_use"] >= now["bytes_in_use"]
+    del keep
+    profiler.set_config(profile_all=True, aggregate_stats=True)
+    profiler.set_state("run")
+    x = mx.nd.ones((64, 64))
+    y = (x * 2).asnumpy()
+    profiler.record_memory_snapshot()
+    table = profiler.dumps()
+    profiler.set_state("stop")
+    assert "Device memory" in table
+    assert "InUse(bytes)" in table
+
+
+def test_dumps_survives_marker_events():
+    """Instant ('i') marker events have no duration — the aggregate table
+    must skip them, not crash (review regression)."""
+    from incubator_mxnet_tpu import profiler
+    profiler.set_config(aggregate_stats=True)
+    profiler.set_state("run")
+    d = profiler.Domain("test")
+    d.new_marker("hello").mark()
+    table = profiler.dumps()
+    profiler.set_state("stop")
+    assert "Name" in table
