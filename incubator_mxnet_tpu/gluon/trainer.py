@@ -75,7 +75,12 @@ class Trainer(object):
             if self._compression_params:
                 kvstore.set_gradient_compression(self._compression_params)
             if "dist" in kvstore.type:
-                update_on_kvstore = False
+                # dist_sync: the store is the in-graph allreduce of GRADS
+                # (push then pull grads, update locally).  dist_async: the
+                # store IS the weights — the host parameter server applies
+                # every push with the server-side optimizer and pulls
+                # return weights (kvstore_dist_server.h async mode)
+                update_on_kvstore = "async" in kvstore.type
             # one batched init: on dist stores this is a single rank-0
             # broadcast collective for all params, not one per key
             kvstore.init(list(range(len(self._params))),
@@ -111,9 +116,13 @@ class Trainer(object):
     def step(self, batch_size, ignore_stale_grad=False):
         """Apply one optimization step with grads scaled by 1/batch_size
         (ref: trainer.py:156 step)."""
+        # rescale BEFORE the kvstore handshake: update_on_kvstore ships a
+        # pickled optimizer to the server exactly once, so the first
+        # step's scaling must already be on it (reference limitation too:
+        # later batch-size changes don't reach the server copy)
+        self._optimizer.rescale_grad = self._scale / batch_size
         if not self._kv_initialized:
             self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         self._update(ignore_stale_grad)
 
@@ -158,6 +167,12 @@ class Trainer(object):
         if not self._kv_initialized:
             self._init_kvstore()
         if self._update_on_kvstore:
+            if self._kvstore_obj._updater is None:
+                # dist_async: optimizer state lives on the parameter
+                # server (same limitation as the reference's PS mode)
+                raise ValueError(
+                    "Cannot save trainer states when the optimizer runs "
+                    "on the parameter server (dist_async)")
             with open(fname, "wb") as fout:
                 fout.write(self._kvstore_obj._updater.get_states(dump_optimizer=True))
         else:
@@ -171,6 +186,10 @@ class Trainer(object):
         with open(fname, "rb") as f:
             states = f.read()
         if self._update_on_kvstore:
+            if self._kvstore_obj._updater is None:
+                raise ValueError(
+                    "Cannot load trainer states when the optimizer runs "
+                    "on the parameter server (dist_async)")
             self._kvstore_obj._updater.set_states(states)
             self._kvstore_obj._updater.optimizer.param_dict = {
                 i: param for i, param in enumerate(self._params)}

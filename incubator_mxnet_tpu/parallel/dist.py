@@ -10,8 +10,10 @@ The KVStore *API* survives intact (SURVEY §5.8): init/push/pull/
 row_sparse_pull/barrier/rank/num_workers/set_optimizer — scripts written
 against dist_sync run unchanged; the transport underneath is collectives.
 `dist_async`'s push-immediately semantics are outside XLA's synchronous
-model; DistKVStore("dist_async") runs sync with a documented warning
-(SURVEY §2.4 marks it a non-goal).
+model, so — exactly as the reference keeps them outside the device — they
+live on a HOST parameter service (parallel/ps.py): rank 0 runs the server
+thread, every push is applied the moment it arrives with the server-side
+optimizer, pulls return current (stale-tolerant) weights.
 """
 from __future__ import annotations
 
@@ -119,19 +121,111 @@ def _global_sum(flat):
     return jnp.asarray(out.addressable_data(0))
 
 
+_ps_counter = [0]   # SPMD-identical creation index → rendezvous key
+
+
 class DistKVStore(KVStore):
-    """dist_sync / dist_device_sync / dist_async over jax.distributed."""
+    """dist_sync / dist_device_sync / dist_async over jax.distributed.
+
+    ``dist_sync``: the wire is an in-graph XLA all-reduce (below).
+    ``dist_async``: true parameter-server semantics on a HOST service —
+    rank 0 runs a ParameterServer thread applying every push immediately
+    with the server-side optimizer; pulls return current (possibly
+    stale) weights.  See parallel/ps.py; matches
+    kvstore_dist_server.h:306-314 async handling."""
 
     def __init__(self, type_):
         super().__init__(type_)
-        if type_ == "dist_async":
-            logging.warning(
-                "dist_async parameter-server semantics are outside XLA's "
-                "synchronous execution model; running synchronously "
-                "(equivalent to dist_sync). See SURVEY.md §2.4.")
         init_process()
+        self._ps_server = None
+        self._ps = None
+        if type_ == "dist_async":
+            from . import ps
+            idx = _ps_counter[0]
+            _ps_counter[0] += 1
+            key = "%s/%d" % (ps._ADDR_KEY, idx)
+            if num_workers() <= 1:
+                self._ps_server = ps.ParameterServer()
+                self._ps = ps.PSClient(self._ps_server.address)
+            elif rank() == 0:
+                self._ps_server = ps.ParameterServer()
+                ps.publish_address(self._ps_server.address, idx)
+                self._ps = ps.PSClient(self._ps_server.address)
+            else:
+                self._ps = ps.PSClient(ps.lookup_address(idx))
+
+    # -- dist_async: the host parameter service -----------------------------
+    def _async_np(self, nd_value):
+        # native dtype on the wire: integer keys must sum exactly, same
+        # contract the sync path keeps (dtype-grouped allreduce below)
+        import numpy as _np
+        return _np.asarray(nd_value._read())
 
     def init(self, key, value):
+        if self._ps is None:
+            return DistKVStore._sync_init(self, key, value)
+        super(DistKVStore, self).init(key, value)   # local shapes/dtypes
+        keys, values = self._normalize(key, value)
+        self._ps.init({str(k): self._async_np(v[0])
+                       for k, v in zip(keys, values)})
+        barrier()   # every rank sees initialized keys before first push
+
+    def push(self, key, value, priority=0):
+        if self._ps is None:
+            return super().push(key, value, priority)
+        from ..ndarray.sparse import BaseSparseNDArray
+        keys, values = self._normalize(key, value)
+        batch = {}
+        for k, vlist in zip(keys, values):
+            if k not in self._store:
+                from ..base import MXNetError
+                raise MXNetError("key %s has not been initialized" % k)
+            red = self._reduce(vlist)
+            if isinstance(red, BaseSparseNDArray):
+                red = red.tostype("default")
+            if self._compressor is not None:
+                red = self._compressor.compress(k, red)
+            batch[str(k)] = self._async_np(red)
+        self._ps.push(batch)    # applied immediately server-side; returns
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if self._ps is None:
+            return super().pull(key, out=out, priority=priority,
+                                ignore_sparse=ignore_sparse)
+        import jax.numpy as _jnp
+        assert out is not None
+        keys, outs = self._normalize(key, out)
+        fetched = self._ps.pull([str(k) for k in keys])
+        for k, olist in zip(keys, outs):
+            v = fetched[str(k)]
+            for o in olist:
+                o._write(_jnp.asarray(v).astype(o.dtype))
+            # refresh the local mirror so row_sparse_pull etc. see it
+            self._store[k]._write(_jnp.asarray(v).astype(
+                self._store[k].dtype))
+
+    def set_optimizer(self, optimizer):
+        if self._ps is None:
+            return DistKVStore._sync_set_optimizer(self, optimizer)
+        self._ps.set_optimizer(optimizer)   # pickled to the server role
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        if self._ps is None:
+            return super().row_sparse_pull(key, out=out, priority=priority,
+                                           row_ids=row_ids)
+        # refresh the local mirror from the server FIRST: the base
+        # implementation row-selects from self._store, which otherwise
+        # holds init-time values forever on the async path
+        import jax.numpy as _jnp
+        keys, _ = self._normalize(key, out)
+        fetched = self._ps.pull([str(k) for k in keys])
+        for k in keys:
+            self._store[k]._write(_jnp.asarray(fetched[str(k)]).astype(
+                self._store[k].dtype))
+        return super().row_sparse_pull(key, out=out, priority=priority,
+                                       row_ids=row_ids)
+
+    def _sync_init(self, key, value):
         """Rank 0's value defines the key globally (ref: kvstore_dist.h
         Init — the first pushed value wins server-side), so workers that
         initialized with different seeds still start in sync."""
@@ -233,9 +327,10 @@ class DistKVStore(KVStore):
                 off += n
         return reds
 
-    def set_optimizer(self, optimizer):
-        """dist path: pickle round-trip, as the reference ships the optimizer
-        to servers (kvstore.py set_optimizer → _send_command_to_servers)."""
+    def _sync_set_optimizer(self, optimizer):
+        """dist_sync path: pickle round-trip, as the reference ships the
+        optimizer to servers (kvstore.py set_optimizer →
+        _send_command_to_servers); the updater runs store-side locally."""
         import pickle
         from .. import optimizer as opt
         self._updater = opt.get_updater(pickle.loads(pickle.dumps(optimizer)))

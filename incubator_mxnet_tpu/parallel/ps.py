@@ -1,0 +1,255 @@
+"""Host-side parameter service: real ``dist_async`` semantics.
+
+The reference's async mode is a ps-lite server applying each worker's
+push the moment it arrives (src/kvstore/kvstore_dist_server.h:113-314:
+``DataHandleEx`` dispatch, async branch at :306-314, the pickled
+optimizer executed server-side via the kController command channel).
+XLA's synchronous SPMD model cannot express that — so, exactly as the
+reference does, the asynchronous state lives on a HOST service:
+
+* rank 0 runs a ``ParameterServer`` thread — a pickle-framed TCP
+  server holding the authoritative f32 weights and applying the
+  (pickled, ``set_optimizer``-shipped) optimizer to every arriving
+  gradient immediately: no barrier, no merge window, pure async.
+* every worker's ``DistKVStore("dist_async")`` connects as a client:
+  ``push`` ships the gradient and returns, ``pull`` fetches whatever
+  the weights are *right now* — staleness included, which is the whole
+  point of async SGD.
+* the server address travels through the jax.distributed coordination
+  service's key-value store (the Postoffice/scheduler's successor), so
+  launch topology stays tools/launch.py with zero extra flags.
+
+This is a prototype-grade transport (one TCP connection per worker,
+pickled frames) standing in for ps-lite's ZMQ — the semantics
+(immediate-apply, server-side updater, update_on_kvstore) are the
+reference's, the wire is deliberately simple.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["ParameterServer", "PSClient", "publish_address",
+           "lookup_address"]
+
+_LEN = struct.Struct("<Q")
+
+
+def _advertised_host():
+    import os
+    env = os.environ.get("MX_PS_HOST")
+    if env:
+        return env
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class ParameterServer(object):
+    """The server role (runs as a daemon thread inside rank 0's process —
+    the reference would run it in dedicated server processes; one thread
+    suffices for the single-server topology)."""
+
+    def __init__(self, host="0.0.0.0", port=0):
+        self._store = {}          # key -> np.ndarray (authoritative)
+        self._updater = None      # (key:int, grad, weight) -> None, in place
+        self._lock = threading.Lock()
+        self._srv = socket.create_server((host, port))
+        # advertise a ROUTABLE address (multi-host workers must reach it;
+        # loopback would only ever work same-machine)
+        adv = _advertised_host()
+        self.address = "%s:%d" % (adv, self._srv.getsockname()[1])
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    # -- server loop -------------------------------------------------------
+    def _serve(self):
+        self._srv.settimeout(0.2)
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                try:
+                    self._dispatch(conn, msg)
+                except (ConnectionError, EOFError, OSError):
+                    raise
+                except Exception as exc:   # server-side failure: REPLY,
+                    # keep the connection alive (a dead handler would
+                    # hang the worker in _recv_msg)
+                    _send_msg(conn, {"ok": False, "error": repr(exc)})
+        except (ConnectionError, EOFError, OSError):
+            return
+
+    def _dispatch(self, conn, msg):
+        cmd = msg["cmd"]
+        if cmd == "init":
+            with self._lock:
+                # first pushed value defines the key
+                # (kvstore_dist.h Init semantics)
+                for k, v in msg["kv"].items():
+                    self._store.setdefault(k, np.array(v))
+            _send_msg(conn, {"ok": True})
+        elif cmd == "push":
+            with self._lock:
+                for k, g in msg["kv"].items():
+                    if self._updater is not None:
+                        # async: apply IMMEDIATELY
+                        # (kvstore_dist_server.h:306-314).  The
+                        # updater speaks NDArray; pin its ops to
+                        # the host CPU backend so the server
+                        # thread never contends for the
+                        # accelerator transport
+                        from ..ndarray import NDArray, array
+                        from ..context import cpu
+                        with cpu(0):
+                            w_nd = array(self._store[k])
+                            g_nd = array(np.asarray(g))
+                            self._updater(self._int_key(k),
+                                          g_nd, w_nd)
+                            self._store[k] = np.asarray(
+                                w_nd.asnumpy())
+                    else:
+                        w = self._store[k]
+                        w += np.asarray(g).astype(w.dtype)
+            _send_msg(conn, {"ok": True})
+        elif cmd == "pull":
+            with self._lock:
+                out = {k: self._store[k].copy() for k in msg["keys"]}
+            _send_msg(conn, {"ok": True, "kv": out})
+        elif cmd == "set_optimizer":
+            # the reference pickles the optimizer to servers
+            # (kvstore.py _send_command_to_servers / kController).
+            # First writer wins: a late rank's (identical)
+            # set_optimizer must NOT wipe accumulated
+            # momentum/Adam state
+            with self._lock:
+                if self._updater is None:
+                    from .. import optimizer as opt
+                    optimizer = pickle.loads(msg["optimizer"])
+                    self._updater = opt.get_updater(optimizer)
+            _send_msg(conn, {"ok": True})
+        elif cmd == "stop":
+            _send_msg(conn, {"ok": True})
+            self.shutdown()
+        else:
+            _send_msg(conn, {"ok": False,
+                             "error": "unknown cmd %r" % cmd})
+
+
+    @staticmethod
+    def _int_key(k):
+        try:
+            return int(k)
+        except (TypeError, ValueError):
+            return abs(hash(k)) % (1 << 31)
+
+    def shutdown(self):
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class PSClient(object):
+    """One worker's connection to the parameter service."""
+
+    def __init__(self, address):
+        host, port = address.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)), timeout=60)
+        self._lock = threading.Lock()
+
+    def _call(self, msg):
+        with self._lock:
+            _send_msg(self._sock, msg)
+            resp = _recv_msg(self._sock)
+        if not resp.get("ok"):
+            raise RuntimeError("parameter server: %s"
+                               % resp.get("error", "unknown failure"))
+        return resp
+
+    def init(self, kv):
+        self._call({"cmd": "init", "kv": kv})
+
+    def push(self, kv):
+        self._call({"cmd": "push", "kv": kv})
+
+    def pull(self, keys):
+        return self._call({"cmd": "pull", "keys": list(keys)})["kv"]
+
+    def set_optimizer(self, optimizer):
+        self._call({"cmd": "set_optimizer",
+                    "optimizer": pickle.dumps(optimizer)})
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# -- address rendezvous through the jax coordination service ---------------
+
+_ADDR_KEY = "mxtpu/ps_address"
+
+
+def _coord_client():
+    from jax._src import distributed
+    state = distributed.global_state
+    return getattr(state, "client", None)
+
+
+def publish_address(address, idx=0):
+    client = _coord_client()
+    if client is not None:
+        client.key_value_set("%s/%d" % (_ADDR_KEY, idx), address)
+
+
+def lookup_address(idx=0, timeout_ms=60000):
+    import os
+    env = os.environ.get("MX_PS_ADDR")
+    if env:
+        return env
+    client = _coord_client()
+    if client is None:
+        raise RuntimeError(
+            "dist_async needs the jax.distributed coordination service "
+            "(run under tools/launch.py) or MX_PS_ADDR set")
+    return client.blocking_key_value_get("%s/%d" % (_ADDR_KEY, idx),
+                                         timeout_ms)

@@ -1,0 +1,68 @@
+"""dist_async parameter-server semantics (parallel/ps.py).
+
+The reference applies each worker's push the moment it arrives on the
+server with the server-side optimizer (kvstore_dist_server.h:306-314);
+our host ParameterServer reproduces that outside XLA's sync model.
+Single-process tests here; the 2-process run lives in
+tests/test_dist_multiprocess.py.
+"""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def test_async_push_applies_immediately():
+    kv = mx.kv.create("dist_async")
+    kv.init("w", nd.ones((4,)) * 10.0)
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 10.0)
+    # no optimizer: pushes accumulate into the weights
+    kv.push("w", nd.ones((4,)) * 2.0)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 12.0)
+
+
+def test_async_server_side_optimizer():
+    kv = mx.kv.create("dist_async")
+    kv.init(3, nd.ones((2, 3)))
+    opt = mx.optimizer.create("sgd", learning_rate=0.5)
+    kv.set_optimizer(opt)
+    # each push applies w -= lr * grad IMMEDIATELY (async, no merge)
+    kv.push(3, nd.ones((2, 3)))
+    kv.push(3, nd.ones((2, 3)))
+    out = nd.zeros((2, 3))
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1.0 - 0.5 - 0.5, atol=1e-6)
+
+
+def test_async_trains_a_model():
+    """A Gluon Trainer over dist_async converges (single worker: the
+    degenerate-but-complete PS loop: push grad -> server update ->
+    pull)."""
+    from incubator_mxnet_tpu import gluon, autograd
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 6).astype(np.float32)
+    W = rs.randn(6, 1).astype(np.float32)
+    y = (X @ W > 0).astype(np.float32).ravel()
+    mx.random.seed(2)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier(magnitude=2.0))
+    kv = mx.kv.create("dist_async")
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.3}, kvstore=kv)
+    lf = gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for ep in range(25):
+        tot = 0.0
+        for i in range(0, 64, 16):
+            xb, yb = nd.array(X[i:i+16]), nd.array(y[i:i+16])
+            with autograd.record():
+                l = lf(net(xb), yb)
+            l.backward()
+            tr.step(16)
+            tot += float(l.asnumpy().mean())
+        losses.append(tot)
+    assert losses[-1] < 0.5 * losses[0], losses

@@ -89,6 +89,20 @@ _KV_WORKER = _PRELUDE + textwrap.dedent("""
     assert np.allclose(oc.asnumpy(), 1.0), oc.asnumpy()
     print("WORKER %d COMPRESS OK" % rank, flush=True)
 
+    # dist_async: true parameter-server semantics on the host service —
+    # each push applies IMMEDIATELY server-side (parallel/ps.py); order
+    # across ranks is free but the commutative SGD algebra pins the sum
+    kva = mx.kv.create("dist_async")
+    kva.init("aw", nd.ones((4,)) * 10.0)
+    import incubator_mxnet_tpu.optimizer as opt
+    kva.set_optimizer(opt.create("sgd", learning_rate=1.0))
+    kva.push("aw", nd.ones((4,)) * (rank + 1))   # -1 and -2, any order
+    kva.barrier()
+    oa2 = nd.zeros((4,))
+    kva.pull("aw", out=oa2)
+    assert np.allclose(oa2.asnumpy(), 7.0), oa2.asnumpy()
+    print("WORKER %d ASYNC OK" % rank, flush=True)
+
     kv.barrier()
     print("WORKER %d OK" % rank)
 """)
@@ -205,6 +219,8 @@ def test_two_process_dist_sync(tmp_path):
     out = _launch_two(tmp_path, _KV_WORKER, timeout=240)
     assert "WORKER 0 OK" in out and "WORKER 1 OK" in out, out[-2000:]
     assert "WORKER 0 COMPRESS OK" in out and "WORKER 1 COMPRESS OK" in out, \
+        out[-2000:]
+    assert "WORKER 0 ASYNC OK" in out and "WORKER 1 ASYNC OK" in out, \
         out[-2000:]
 
 
