@@ -1,0 +1,89 @@
+"""Benchmark: Gluon LSTM language model — tokens/sec on one chip.
+
+The second metric named by BASELINE.json ("Gluon LSTM tokens/sec", config
+"Gluon LSTM language model (example/gluon, hybridize())").  Workload: the
+classic word-LM shape — embedding → multi-layer LSTM (the lax.scan fused
+kernel standing in for cudnnRNNForwardTraining) → vocab projection,
+trained end-to-end (forward + CE loss + backward + SGD update) as ONE
+jitted XLA program via DataParallelTrainer, bf16 compute with f32 master
+weights.
+
+Prints ONE JSON line:
+  {"metric": "gluon_lstm_train_tokens_per_sec", "value": N,
+   "unit": "tokens/s", ...}
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.gluon import nn, rnn
+    from incubator_mxnet_tpu.parallel import make_mesh, DataParallelTrainer
+
+    vocab = int(os.environ.get("BENCH_VOCAB", "10000"))
+    embed = int(os.environ.get("BENCH_EMBED", "512"))
+    hidden = int(os.environ.get("BENCH_HIDDEN", "512"))
+    layers = 2
+    seq_len = int(os.environ.get("BENCH_SEQ", "128"))
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    n_steps = int(os.environ.get("BENCH_STEPS", "20"))
+
+    mx.random.seed(0)
+
+    class WordLM(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.embed = nn.Embedding(vocab, embed)
+                self.lstm = rnn.LSTM(hidden, num_layers=layers,
+                                     layout="NTC", input_size=embed)
+                self.proj = nn.Dense(vocab, flatten=False,
+                                     in_units=hidden)
+
+        def hybrid_forward(self, F, x):
+            h = self.embed(x)
+            h = self.lstm(h)
+            return self.proj(h)
+
+    net = WordLM()
+    net.initialize(mx.init.Xavier())
+
+    mesh = make_mesh({"dp": 1}, jax.devices()[:1])
+    trainer = DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        optimizer="sgd", optimizer_params={"learning_rate": 0.5},
+        mesh=mesh, dtype="bfloat16")
+
+    rs = np.random.RandomState(0)
+    x = mx.nd.array((rs.rand(batch, seq_len) * vocab).astype(np.float32))
+    y = mx.nd.array((rs.rand(batch, seq_len) * vocab).astype(np.float32))
+
+    for _ in range(3):
+        loss = trainer.step(x, y)
+    float(np.asarray(loss))
+
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = trainer.step(x, y)
+    final = float(np.asarray(loss))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final), "lstm bench loss went non-finite"
+
+    tok_s = n_steps * batch * seq_len / dt
+    print(json.dumps({
+        "metric": "gluon_lstm_train_tokens_per_sec",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "batch": batch, "seq_len": seq_len,
+        "hidden": hidden, "layers": layers, "vocab": vocab,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -66,3 +66,26 @@ def test_async_trains_a_model():
             tot += float(l.asnumpy().mean())
         losses.append(tot)
     assert losses[-1] < 0.5 * losses[0], losses
+
+
+def test_async_module_fit():
+    """Module.fit over dist_async: update_on_kvstore routes updates to
+    the parameter server (the reference's PS training flow)."""
+    mx.random.seed(4)
+    rs = np.random.RandomState(0)
+    X = rs.randn(128, 10).astype(np.float32)
+    W = rs.randn(10, 1).astype(np.float32)
+    y = (X @ W > 0).astype(np.float32).ravel()
+    data = mx.io.NDArrayIter(X, y, batch_size=16, label_name="softmax_label")
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    kv = mx.kv.create("dist_async")
+    mod.fit(data, num_epoch=12, kvstore=kv,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.2},
+            initializer=mx.init.Xavier(magnitude=2.0))
+    score = dict(mod.score(data, "acc"))
+    assert score["accuracy"] > 0.9, score

@@ -52,3 +52,19 @@ def test_train_imagenet_smoke(capsys, surface):
     _run("train_imagenet.py", argv)
     out = capsys.readouterr().out
     assert "validation accuracy" in out
+
+
+def test_bench_lstm_smoke(capsys, monkeypatch):
+    """The LSTM tokens/sec bench (BASELINE.json's second metric) must run
+    on the CPU mesh."""
+    import json
+    for k, v in (("BENCH_BATCH", "8"), ("BENCH_SEQ", "16"),
+                 ("BENCH_VOCAB", "200"), ("BENCH_EMBED", "32"),
+                 ("BENCH_HIDDEN", "32"), ("BENCH_STEPS", "3")):
+        monkeypatch.setenv(k, v)
+    runpy.run_path(os.path.join(os.path.dirname(__file__), "..",
+                                "bench_lstm.py"), run_name="__main__")
+    out = capsys.readouterr().out
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["metric"] == "gluon_lstm_train_tokens_per_sec"
+    assert rec["value"] > 0
