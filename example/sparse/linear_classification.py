@@ -1,0 +1,124 @@
+"""Sparse linear classification on LibSVM data — BASELINE config 5
+(ref: example/sparse/linear_classification/train.py: CSR data through
+LibSVMIter, a RowSparse weight updated store-side, row_sparse_pull
+fetching only the rows a batch touches).
+
+Data: a real .libsvm file via ``--data``; otherwise a synthetic sparse
+two-class problem is generated on the fly (no egress here).  Model:
+logistic regression over a high-dimensional sparse feature space —
+``scores = X_csr · w + b`` via ``mx.nd.sparse.dot``; the weight gradient
+is row-sparse (only features present in the batch), pushed to the kvstore
+whose server-side SGD applies it (update_on_kvstore, the reference's
+sparse flow), and the next batch row_sparse_pulls just the rows it needs.
+
+Usage:
+    python linear_classification.py
+    python linear_classification.py --data path/to/train.libsvm --dim 47236
+    python ../../tools/launch.py -n 2 python linear_classification.py \
+        --kv-store dist_sync
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import nd, autograd  # noqa: E402
+
+
+def make_synthetic_libsvm(path, n=2000, dim=1000, nnz=12, seed=0):
+    """Two-class sparse data: label = sign(w_true · x)."""
+    rs = np.random.RandomState(seed)
+    w_true = rs.randn(dim)
+    with open(path, "w") as f:
+        for _ in range(n):
+            idx = np.sort(rs.choice(dim, size=nnz, replace=False))
+            val = rs.randn(nnz)
+            label = 1 if float(w_true[idx] @ val) > 0 else 0
+            feats = " ".join("%d:%.5f" % (i, v) for i, v in zip(idx, val))
+            f.write("%d %s\n" % (label, feats))
+    return path
+
+
+def main():
+    parser = argparse.ArgumentParser(description="sparse linear classifier")
+    parser.add_argument("--data", default="", help=".libsvm file (synthetic "
+                        "fallback when empty)")
+    parser.add_argument("--dim", type=int, default=1000,
+                        help="feature dimension")
+    parser.add_argument("--batch-size", type=int, default=100)
+    parser.add_argument("--num-epochs", type=int, default=12)
+    parser.add_argument("--lr", type=float, default=0.5)
+    parser.add_argument("--kv-store", default="local")
+    parser.add_argument("--seed", type=int, default=7)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    if "dist" in args.kv_store:
+        from incubator_mxnet_tpu.parallel import dist
+        dist.init_process()
+    mx.random.seed(args.seed)
+
+    # per-rank file: concurrent workers must not race a shared tmp path
+    synth = "/tmp/sparse_example_rank%d.libsvm" % (
+        int(os.environ.get("MX_PROCESS_ID", "0")))
+    path = args.data or make_synthetic_libsvm(synth, dim=args.dim)
+    it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(args.dim,),
+                          batch_size=args.batch_size)
+
+    kv = mx.kv.create(args.kv_store)
+    rank, nw = kv.rank, max(kv.num_workers, 1)
+
+    w = nd.zeros((args.dim, 1)).tostype("row_sparse")
+    b = nd.zeros((1,))
+    kv.init("w", w)
+    kv.init("b", b)
+    # server-side optimizer: pushes apply the update ON the store and
+    # pulls return weights (the reference's update_on_kvstore sparse flow)
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=args.lr))
+
+    final_acc = 0.0
+    for epoch in range(args.num_epochs):
+        it.reset()
+        total = correct = 0
+        loss_sum = 0.0
+        nbatches = 0
+        for bi, batch in enumerate(it):
+            if nw > 1 and bi % nw != rank:
+                continue    # shard batches across workers
+            x_csr = batch.data[0]          # CSRNDArray
+            y = batch.label[0]
+            # pull ONLY the rows this batch touches (row_sparse_pull —
+            # the PS-era embedding/linear-model fast path)
+            row_ids = nd.array(np.unique(np.asarray(
+                x_csr.indices.asnumpy(), dtype=np.int64)))
+            kv.row_sparse_pull("w", out=w, row_ids=row_ids)
+            kv.pull("b", out=b)
+            dense_w = w.tostype("default")
+            dense_w.attach_grad()
+            b.attach_grad()
+            with autograd.record():
+                scores = nd.sparse.dot(x_csr, dense_w) + b
+                z = scores.reshape((-1,))
+                loss = nd.mean(nd.log(1 + nd.exp(-(2 * y - 1) * z)))
+            loss.backward()
+            # only rows present in the batch carry gradient: row-sparse push
+            kv.push("w", dense_w.grad.tostype("row_sparse"))
+            kv.push("b", b.grad)
+            loss_sum += float(loss.asscalar())
+            nbatches += 1
+            pred = (np.asarray(z.asnumpy()) > 0).astype(np.int64)
+            correct += int((pred == y.asnumpy().astype(np.int64)).sum())
+            total += len(pred)
+        final_acc = correct / max(total, 1)
+        logging.info("epoch %d loss %.4f acc %.3f", epoch,
+                     loss_sum / max(nbatches, 1), final_acc)
+    print("final training accuracy: %.4f" % final_acc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,170 @@
+"""Single-shot object detection — BASELINE config 4 (ref: example/ssd:
+the multibox CUDA ops, here TPU formulations in ops/vision.py).
+
+A compact SSD: small conv backbone → per-location class scores + box
+offsets over MultiBoxPrior anchors; training targets from MultiBoxTarget
+(anchor matching + hard-negative mining semantics), loss = softmax CE on
+classes + smooth-L1 on masked offsets; inference decodes + NMS via
+MultiBoxDetection.  Data: synthetic scenes — one colored square per image
+on textured background — generated on the fly (no egress here); plug a
+real ImageDetIter via --data-rec for .rec detection datasets
+(im2rec-packed, label [cls x1 y1 x2 y2] normalized).
+
+Usage:
+    python train.py                     # synthetic, CPU-mesh friendly
+    python train.py --num-epochs 20 --eval-iou 0.5
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import nd, autograd, gluon  # noqa: E402
+from incubator_mxnet_tpu.gluon import nn  # noqa: E402
+
+
+NUM_CLASSES = 2          # background + square
+SIZES = (0.3, 0.55)
+RATIOS = (1.0, 2.0, 0.5)
+NUM_ANCHORS = len(SIZES) + len(RATIOS) - 1
+
+
+def make_scene(rs, size=32):
+    """One image with one axis-aligned bright square; returns (img CHW,
+    label (1, 5) [cls, x1, y1, x2, y2] normalized)."""
+    img = rs.rand(3, size, size).astype(np.float32) * 0.3
+    s = rs.randint(size // 4, size // 2)
+    x0 = rs.randint(0, size - s)
+    y0 = rs.randint(0, size - s)
+    img[:, y0:y0 + s, x0:x0 + s] = rs.rand(3, 1, 1) * 0.5 + 0.5
+    # class ids are 0-based in labels; MultiBoxTarget emits id+1 with 0 =
+    # background (multibox_target.cc convention)
+    label = np.array([[0, x0 / size, y0 / size,
+                       (x0 + s) / size, (y0 + s) / size]], np.float32)
+    return img, label
+
+
+class TinySSD(gluon.HybridBlock):
+    """Backbone + twin heads (ref: example/ssd/symbol — one scale here)."""
+
+    def __init__(self):
+        super().__init__()
+        with self.name_scope():
+            self.backbone = nn.HybridSequential()
+            for filters in (16, 32, 64):
+                self.backbone.add(nn.Conv2D(filters, 3, padding=1,
+                                            strides=2),
+                                  nn.BatchNorm(),
+                                  nn.Activation("relu"))
+            self.cls_head = nn.Conv2D(NUM_ANCHORS * NUM_CLASSES, 3,
+                                      padding=1)
+            self.loc_head = nn.Conv2D(NUM_ANCHORS * 4, 3, padding=1)
+
+    def hybrid_forward(self, F, x):
+        feat = self.backbone(x)
+        cls = self.cls_head(feat)    # (B, A*C, H, W)
+        loc = self.loc_head(feat)    # (B, A*4, H, W)
+        B = x.shape[0]
+        cls = F.reshape(F.transpose(cls, axes=(0, 2, 3, 1)),
+                        shape=(B, -1, NUM_CLASSES))     # (B, HWA, C)
+        loc = F.reshape(F.transpose(loc, axes=(0, 2, 3, 1)),
+                        shape=(B, -1))                  # (B, HWA*4)
+        return feat, cls, loc
+
+
+def smooth_l1(x):
+    ax = nd.abs(x)
+    return nd.where(ax < 1.0, 0.5 * x * x, ax - 0.5)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="tiny SSD")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-epochs", type=int, default=12)
+    parser.add_argument("--num-batches", type=int, default=4)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--eval-iou", type=float, default=0.3)
+    parser.add_argument("--seed", type=int, default=3)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(args.seed)
+    rs = np.random.RandomState(args.seed)
+
+    n = args.batch_size * args.num_batches
+    imgs, labels = zip(*(make_scene(rs) for _ in range(n)))
+    X = np.stack(imgs)
+    Y = np.stack(labels)
+
+    net = TinySSD()
+    net.initialize(mx.init.Xavier(magnitude=2.0))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    anchors = None
+    for epoch in range(args.num_epochs):
+        tot = 0.0
+        for i in range(0, n, args.batch_size):
+            xb = nd.array(X[i:i + args.batch_size])
+            yb = nd.array(Y[i:i + args.batch_size])
+            with autograd.record():
+                feat, cls, loc = net(xb)
+                if anchors is None:
+                    anchors = nd.MultiBoxPrior(feat, sizes=SIZES,
+                                               ratios=RATIOS)
+                loc_t, loc_m, cls_t = nd.MultiBoxTarget(
+                    anchors, yb, nd.transpose(cls, axes=(0, 2, 1)),
+                    negative_mining_ratio=3.0)
+                # anchors marked ignore_label (-1) by hard-negative mining
+                # must not reach the CE (the reference feeds SoftmaxOutput
+                # with use_ignore=True); mask them out explicitly
+                valid = cls_t >= 0
+                oh = nd.one_hot(nd.broadcast_maximum(cls_t, nd.zeros((1,))),
+                                depth=NUM_CLASSES)
+                ce = -nd.sum(oh * nd.log_softmax(cls, axis=-1), axis=-1)
+                nvalid = nd.broadcast_maximum(nd.sum(valid, axis=1),
+                                              nd.ones((1,)))
+                l_cls = nd.sum(ce * valid, axis=1) / nvalid
+                l_loc = nd.mean(smooth_l1((loc - loc_t) * loc_m),
+                                axis=1)
+                loss = l_cls + l_loc
+            loss.backward()
+            trainer.step(xb.shape[0])
+            tot += float(nd.mean(loss).asscalar())
+        logging.info("epoch %d loss %.4f", epoch,
+                     tot / max(args.num_batches, 1))
+
+    # -- evaluation: decode + NMS, IoU of top detection vs ground truth --
+    hits = 0
+    for i in range(n):
+        xb = nd.array(X[i:i + 1])
+        feat, cls, loc = net(xb)
+        probs = nd.softmax(cls, axis=-1)
+        dets = nd.MultiBoxDetection(
+            nd.transpose(probs, axes=(0, 2, 1)), loc, anchors,
+            nms_threshold=0.45)
+        d = dets.asnumpy()[0]
+        d = d[d[:, 0] >= 0]
+        if not len(d):
+            continue
+        best = d[np.argmax(d[:, 1])]
+        gt = Y[i, 0, 1:]
+        bx = best[2:6]
+        ix1, iy1 = max(bx[0], gt[0]), max(bx[1], gt[1])
+        ix2, iy2 = min(bx[2], gt[2]), min(bx[3], gt[3])
+        inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+        union = ((bx[2] - bx[0]) * (bx[3] - bx[1])
+                 + (gt[2] - gt[0]) * (gt[3] - gt[1]) - inter)
+        if union > 0 and inter / union >= args.eval_iou:
+            hits += 1
+    recall = hits / n
+    logging.info("detection recall@IoU%.1f = %.3f", args.eval_iou, recall)
+    print("recall: %.4f" % recall)
+
+
+if __name__ == "__main__":
+    main()

@@ -501,30 +501,56 @@ class LibSVMIter(DataIter):
                     indices.append(int(k))
                     values.append(float(v))
                 indptr.append(len(indices))
-        n = len(labels)
-        dense = np.zeros((n,) + tuple(data_shape), np.float32)
-        for i in range(n):
-            for j in range(indptr[i], indptr[i + 1]):
-                dense[i, indices[j]] = values[j]
-        self._csr_parts = (np.array(values, np.float32),
-                           np.array(indices, np.int64),
-                           np.array(indptr, np.int64))
-        self._inner = NDArrayIter(dense, np.array(labels, np.float32),
-                                  batch_size)
+        # keep the data CSR end-to-end: the reference never materializes
+        # LibSVM rows densely (iter_libsvm.cc parses straight to
+        # kCSRStorage) — an (n, dim) dense buffer would OOM at RCV1 scale
+        self._values = np.array(values, np.float32)
+        self._indices = np.array(indices, np.int64)
+        self._indptr = np.array(indptr, np.int64)
+        self._labels = np.array(labels, np.float32)
+        self._dim = int(np.prod(data_shape))
+        self._n = len(labels)
+        self._cursor = 0
 
     @property
     def provide_data(self):
-        return self._inner.provide_data
+        return [DataDesc("data", (self.batch_size, self._dim), "float32")]
 
     @property
     def provide_label(self):
-        return self._inner.provide_label
+        return [DataDesc("softmax_label", (self.batch_size,), "float32")]
 
     def reset(self):
-        self._inner.reset()
+        self._cursor = 0
 
     def next(self):
-        return self._inner.next()
+        """Batches carry CSR data (the reference's LibSVMIter yields
+        kCSRStorage batches, iter_libsvm.cc) — sparse models feed
+        mx.nd.sparse.dot without densifying.  Built by slicing the parsed
+        CSR triple per batch; the tail batch pads by wrapping."""
+        from .ndarray import sparse as sp
+        if self._cursor >= self._n:
+            raise StopIteration
+        rows = [(self._cursor + i) % self._n
+                for i in range(self.batch_size)]
+        pad = max(self._cursor + self.batch_size - self._n, 0)
+        self._cursor += self.batch_size
+        data_parts, idx_parts, ptr = [], [], [0]
+        for r in rows:
+            lo, hi = self._indptr[r], self._indptr[r + 1]
+            data_parts.append(self._values[lo:hi])
+            idx_parts.append(self._indices[lo:hi])
+            ptr.append(ptr[-1] + (hi - lo))
+        csr = sp.csr_matrix(
+            (np.concatenate(data_parts) if data_parts else
+             np.zeros(0, np.float32),
+             np.concatenate(idx_parts) if idx_parts else
+             np.zeros(0, np.int64),
+             np.array(ptr, np.int64)),
+            shape=(self.batch_size, self._dim))
+        from .ndarray import array as _arr
+        labels = _arr(self._labels[rows])
+        return DataBatch([csr], [labels], pad)
 
 
 class MNISTIter(DataIter):

@@ -247,6 +247,25 @@ def _csr_row_ids(csr):
     return jnp.searchsorted(ptr, jnp.arange(nnz), side="right") - 1
 
 
+def _dense_operand_op(name, fn_dense, rhs, ctx):
+    """Run a sparse kernel that is differentiable in its DENSE operand and
+    RECORD it on the autograd tape (the hand-rolled sparse paths bypass
+    ndarray.invoke, so without this the tape silently treated their
+    outputs as constants — zero gradient to the dense weight, the exact
+    case the reference's csr-dot backward serves, dot-inl.h backward).
+    Gradients w.r.t. the sparse operand itself stay unsupported (parity:
+    the reference likewise differentiates only the dense side)."""
+    from .. import autograd
+    if autograd.is_recording():
+        out_val, vjp_fn = jax.vjp(fn_dense, rhs._read())
+        out_nd = NDArray(out_val, ctx=ctx)
+        from ..ops.registry import Operator
+        op = Operator(name, fn_dense, num_inputs=1)
+        autograd._record(op, [rhs], [out_nd], vjp_fn, fn=fn_dense)
+        return out_nd
+    return NDArray(fn_dense(rhs._read()), ctx=ctx)
+
+
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     """Sparse-aware dot (ref: dot-inl.h — csr×dense and csrᵀ×dense kernels;
     python surface mx.nd.sparse.dot)."""
@@ -254,16 +273,20 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
         row = _csr_row_ids(lhs)
         col = lhs.indices._read().astype(jnp.int32)
         data = lhs.data._read()
-        r = rhs._read()
-        if transpose_b:
-            r = r.T
         if transpose_a:
             # csrᵀ @ dense: scatter rows of dense by col
-            out = jax.ops.segment_sum(data[:, None] * r[row], col,
-                                      num_segments=lhs.shape[1])
-            return NDArray(out, ctx=lhs._ctx)
-        out = _csr_matmul(data, col, row, r, lhs.shape[0])
-        return NDArray(out, ctx=lhs._ctx)
+            def fn(r_, data=data, row=row, col=col, n=lhs.shape[1]):
+                if transpose_b:
+                    r_ = r_.T
+                return jax.ops.segment_sum(data[:, None] * r_[row], col,
+                                           num_segments=n)
+            return _dense_operand_op("_sparse_dot_csrT", fn, rhs, lhs._ctx)
+
+        def fn(r_, data=data, row=row, col=col, m=lhs.shape[0]):
+            if transpose_b:
+                r_ = r_.T
+            return _csr_matmul(data, col, row, r_, m)
+        return _dense_operand_op("_sparse_dot_csr", fn, rhs, lhs._ctx)
     if isinstance(lhs, RowSparseNDArray) and not isinstance(rhs, BaseSparseNDArray):
         if transpose_a or transpose_b:
             # no transposed rsp kernel (parity: dot-inl.h only dispatches
@@ -272,9 +295,12 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
                        transpose_a=transpose_a, transpose_b=transpose_b)
         # rsp @ dense: dense rows gather-matmul, scatter into result
         idx = lhs.indices._read().astype(jnp.int32)
-        out = jnp.zeros((lhs.shape[0], rhs.shape[1]), lhs.data._read().dtype)
-        out = out.at[idx].set(lhs.data._read() @ rhs._read())
-        return NDArray(out, ctx=lhs._ctx)
+        ldata = lhs.data._read()
+
+        def fn(r_, idx=idx, ldata=ldata, m=lhs.shape[0]):
+            out = jnp.zeros((m, r_.shape[1]), ldata.dtype)
+            return out.at[idx].set(ldata @ r_)
+        return _dense_operand_op("_sparse_dot_rsp", fn, rhs, lhs._ctx)
     if isinstance(rhs, RowSparseNDArray):
         # dense @ rsp has no sparse kernel either way — densify rhs
         return dot(lhs, NDArray(rhs.todense()._read(), ctx=rhs._ctx),

@@ -11,17 +11,18 @@ _DIR = os.path.join(os.path.dirname(__file__), "..", "example",
                     "image-classification")
 
 
-def _run(script, argv):
+def _run(script, argv, directory=None):
+    directory = directory or _DIR
     old = sys.argv
     sys.argv = [script] + argv
-    sys.path.insert(0, _DIR)
+    sys.path.insert(0, directory)
     try:
-        runpy.run_path(os.path.join(_DIR, script), run_name="__main__")
+        runpy.run_path(os.path.join(directory, script), run_name="__main__")
     except SystemExit as e:
         assert not e.code, e.code
     finally:
         sys.argv = old
-        sys.path.remove(_DIR)
+        sys.path.remove(directory)
 
 
 def test_train_mnist_module(capsys):
@@ -68,3 +69,22 @@ def test_bench_lstm_smoke(capsys, monkeypatch):
     rec = json.loads(out.strip().splitlines()[-1])
     assert rec["metric"] == "gluon_lstm_train_tokens_per_sec"
     assert rec["value"] > 0
+
+
+def test_sparse_example_smoke(capsys):
+    d = os.path.join(os.path.dirname(__file__), "..", "example", "sparse")
+    _run("linear_classification.py",
+         ["--num-epochs", "6", "--dim", "300", "--batch-size", "100"],
+         directory=d)
+    out = capsys.readouterr().out
+    acc = float(out.strip().rsplit(" ", 1)[-1])
+    assert acc > 0.8, out
+
+
+def test_ssd_example_smoke(capsys):
+    d = os.path.join(os.path.dirname(__file__), "..", "example", "ssd")
+    _run("train.py", ["--num-epochs", "12", "--batch-size", "16",
+                      "--num-batches", "2"], directory=d)
+    out = capsys.readouterr().out
+    recall = float(out.strip().rsplit(" ", 1)[-1])
+    assert recall > 0.5, out
