@@ -19,6 +19,7 @@ Usage:
 """
 import argparse
 import logging
+import math
 import os
 import sys
 
@@ -81,6 +82,13 @@ def main():
     # pulls return weights (the reference's update_on_kvstore sparse flow)
     kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=args.lr))
 
+    # dist kvstore pushes are lockstep collectives: every rank must issue
+    # the SAME number — truncate to a batch count divisible by num_workers
+    with open(path) as f:
+        n_rows = sum(1 for line in f if line.strip())
+    n_batches = math.ceil(n_rows / args.batch_size)
+    common = (n_batches // nw) * nw if nw > 1 else n_batches
+
     final_acc = 0.0
     for epoch in range(args.num_epochs):
         it.reset()
@@ -88,6 +96,8 @@ def main():
         loss_sum = 0.0
         nbatches = 0
         for bi, batch in enumerate(it):
+            if bi >= common:
+                break       # keep collective counts rank-identical
             if nw > 1 and bi % nw != rank:
                 continue    # shard batches across workers
             x_csr = batch.data[0]          # CSRNDArray

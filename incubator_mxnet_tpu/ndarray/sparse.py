@@ -115,6 +115,27 @@ class CSRNDArray(BaseSparseNDArray):
         dense = jnp.zeros((m, n), d.dtype).at[row, col].set(d)
         return NDArray(dense, ctx=self._ctx)
 
+    def __getitem__(self, key):
+        """Row slicing stays CSR (ref: sparse.py CSRNDArray.__getitem__ —
+        the reference supports basic slicing on csr; needed e.g. by
+        DataParallelExecutorGroup splitting a LibSVMIter batch across
+        contexts)."""
+        if isinstance(key, int):
+            key = slice(key, key + 1)
+        if not isinstance(key, slice) or key.step not in (None, 1):
+            raise ValueError(
+                "CSRNDArray only supports contiguous row slicing, got %r"
+                % (key,))
+        start, stop, _ = key.indices(self._shape[0])
+        ptr = np.asarray(self.indptr._read())
+        lo, hi = int(ptr[start]), int(ptr[stop])
+        new_ptr = ptr[start:stop + 1] - ptr[start]
+        return CSRNDArray(
+            NDArray(self.data._read()[lo:hi], ctx=self._ctx),
+            NDArray(self.indices._read()[lo:hi], ctx=self._ctx),
+            NDArray(jnp.asarray(new_ptr), ctx=self._ctx),
+            (stop - start, self._shape[1]), ctx=self._ctx)
+
     def __repr__(self):
         return "\n<CSRNDArray %s @%s>" % (
             "x".join(str(s) for s in self._shape), self._ctx)

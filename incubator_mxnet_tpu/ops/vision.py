@@ -132,7 +132,7 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
     A = anchors.shape[0]
     var = jnp.asarray(variances, jnp.float32)
 
-    def per_sample(lab):
+    def per_sample(lab, pred):
         valid = lab[:, 0] >= 0                          # (M,)
         gt = lab[:, 1:5]
         iou = _box_iou_corner(anchors, gt)              # (A, M)
@@ -145,6 +145,25 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
         pos = forced | (best_iou >= overlap_threshold)
         matched_gt = gt[best_gt]                        # (A, 4)
         cls = jnp.where(pos, lab[best_gt, 0] + 1, 0.0)  # 0 = background
+        if negative_mining_ratio > 0:
+            # hard-negative mining (multibox_target.cc): unmatched anchors
+            # whose max non-background confidence clears the threshold
+            # compete for ratio×num_pos background slots (>= the minimum);
+            # every other negative is marked ignore_label and must not
+            # reach the classification loss
+            neg = ~pos
+            hard = (jnp.max(pred[1:], axis=0) if pred.shape[0] > 1
+                    else jnp.zeros((A,), pred.dtype))
+            cand = neg & (hard > negative_mining_thresh)
+            num_keep = jnp.maximum(
+                negative_mining_ratio * jnp.sum(pos),
+                float(minimum_negative_samples))
+            score = jnp.where(cand, hard, -jnp.inf)
+            order = jnp.argsort(-score)
+            rank = jnp.zeros((A,), jnp.int32).at[order].set(
+                jnp.arange(A, dtype=jnp.int32))
+            keep_neg = cand & (rank < num_keep)
+            cls = jnp.where(neg & ~keep_neg, ignore_label, cls)
         # encode offsets (center form, variance-scaled)
         aw = anchors[:, 2] - anchors[:, 0]
         ah = anchors[:, 3] - anchors[:, 1]
@@ -163,7 +182,7 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
         loc_m = jnp.broadcast_to(pos[:, None], (A, 4)).astype(jnp.float32)
         return loc_t.reshape(-1), loc_m.reshape(-1), cls
 
-    loc_target, loc_mask, cls_target = jax.vmap(per_sample)(label)
+    loc_target, loc_mask, cls_target = jax.vmap(per_sample)(label, cls_pred)
     return loc_target, loc_mask, cls_target
 
 

@@ -88,3 +88,14 @@ def test_ssd_example_smoke(capsys):
     out = capsys.readouterr().out
     recall = float(out.strip().rsplit(" ", 1)[-1])
     assert recall > 0.5, out
+
+
+def test_word_lm_example_smoke(capsys):
+    d = os.path.join(os.path.dirname(__file__), "..", "example", "gluon")
+    _run("word_lm.py", ["--num-epochs", "3", "--hidden", "32",
+                        "--embed", "16", "--batch-size", "16"],
+         directory=d)
+    out = capsys.readouterr().out
+    ppl = float(out.split("final ppl:")[1].split()[0])
+    unigram = float(out.split("(unigram")[1].split(")")[0])
+    assert ppl < unigram, out

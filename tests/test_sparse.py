@@ -157,3 +157,16 @@ def test_dense_sparse_dot_transpose_a():
     A3 = rs.randn(3, 6).astype(np.float32)
     out3 = sp.dot(mx.nd.array(A3), csr, transpose_b=True)
     np.testing.assert_allclose(out3.asnumpy(), A3 @ B.T, rtol=1e-5, atol=1e-6)
+
+
+def test_csr_row_slicing():
+    """CSRNDArray row slices stay CSR and match the dense slice (needed
+    by executor-group batch splitting over LibSVMIter batches)."""
+    rs = np.random.RandomState(0)
+    dense = (rs.rand(7, 5) < 0.4) * rs.randn(7, 5).astype(np.float32)
+    csr = mx.nd.array(dense).tostype("csr")
+    for key in (slice(2, 6), slice(0, 7), 3):
+        sl = csr[key]
+        want = dense[key if isinstance(key, slice) else slice(key, key + 1)]
+        assert sl.stype == "csr"
+        np.testing.assert_allclose(sl.todense().asnumpy(), want)

@@ -158,3 +158,31 @@ def test_bilinear_sampler_grad():
         out = mx.nd.BilinearSampler(data, grid)
     out.backward()
     assert np.abs(data.grad.asnumpy()).sum() > 0
+
+
+def test_multibox_target_negative_mining():
+    """negative_mining_ratio keeps only ratio x num_pos hard negatives as
+    background; the rest become ignore_label (multibox_target.cc)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    rs = np.random.RandomState(0)
+    # a 4x4 grid of unit anchors; one gt box matching the first anchor
+    xs, ys_ = np.meshgrid(np.arange(4) / 4.0, np.arange(4) / 4.0)
+    anchors = np.stack([xs.ravel(), ys_.ravel(),
+                        xs.ravel() + 0.25, ys_.ravel() + 0.25], 1)
+    anchors = anchors[None].astype(np.float32)           # (1, 16, 4)
+    label = np.array([[[0, 0.0, 0.0, 0.25, 0.25]]], np.float32)
+    cls_pred = rs.rand(1, 2, 16).astype(np.float32)      # confident junk
+    lt, lm, ct = nd.MultiBoxTarget(nd.array(anchors), nd.array(label),
+                                   nd.array(cls_pred),
+                                   negative_mining_ratio=3.0,
+                                   negative_mining_thresh=0.0)
+    c = ct.asnumpy()[0]
+    assert (c == 1).sum() == 1                  # one positive (cls 0 -> 1)
+    assert (c == 0).sum() == 3                  # 3x1 hard negatives kept
+    assert (c == -1).sum() == 12                # the rest ignored
+    # without mining every negative stays background
+    _, _, ct2 = nd.MultiBoxTarget(nd.array(anchors), nd.array(label),
+                                  nd.array(cls_pred))
+    c2 = ct2.asnumpy()[0]
+    assert (c2 == -1).sum() == 0 and (c2 == 0).sum() == 15
