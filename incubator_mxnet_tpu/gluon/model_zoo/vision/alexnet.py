@@ -1,4 +1,11 @@
-"""AlexNet (ref: python/mxnet/gluon/model_zoo/vision/alexnet.py)."""
+"""AlexNet for the TPU model zoo.
+
+Layer constants follow Krizhevsky et al. (the one-tower variant the MXNet
+zoo ships).  API and checkpoint-key parity with the reference zoo (ref:
+python/mxnet/gluon/model_zoo/vision/alexnet.py) is asserted by
+``tests/test_model_zoo_rewrite.py``.  The net is stamped out from two
+spec tables instead of a hand-unrolled ``add`` ladder.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock
@@ -7,43 +14,41 @@ from ...nn import (HybridSequential, Conv2D, Dense, Dropout, MaxPool2D,
 
 __all__ = ["AlexNet", "alexnet"]
 
+# (width, kernel, stride, pad, max-pool after?)
+_STEM = [(64, 11, 4, 2, True),
+         (192, 5, 1, 2, True),
+         (384, 3, 1, 1, False),
+         (256, 3, 1, 1, False),
+         (256, 3, 1, 1, True)]
+_HEAD_WIDTH, _HEAD_DROP = 4096, 0.5
+
 
 class AlexNet(HybridBlock):
-    """ref: alexnet.py class AlexNet."""
+    """Five-conv stem driven by ``_STEM``, two dropout-regularised Dense
+    layers, and a linear classifier."""
 
     def __init__(self, classes=1000, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
-            self.features = HybridSequential(prefix="")
-            with self.features.name_scope():
-                self.features.add(Conv2D(64, kernel_size=11, strides=4,
-                                         padding=2, activation="relu"))
-                self.features.add(MaxPool2D(pool_size=3, strides=2))
-                self.features.add(Conv2D(192, kernel_size=5, padding=2,
-                                         activation="relu"))
-                self.features.add(MaxPool2D(pool_size=3, strides=2))
-                self.features.add(Conv2D(384, kernel_size=3, padding=1,
-                                         activation="relu"))
-                self.features.add(Conv2D(256, kernel_size=3, padding=1,
-                                         activation="relu"))
-                self.features.add(Conv2D(256, kernel_size=3, padding=1,
-                                         activation="relu"))
-                self.features.add(MaxPool2D(pool_size=3, strides=2))
-                self.features.add(Flatten())
-                self.features.add(Dense(4096, activation="relu"))
-                self.features.add(Dropout(0.5))
-                self.features.add(Dense(4096, activation="relu"))
-                self.features.add(Dropout(0.5))
+            feats = HybridSequential(prefix="")
+            for width, kernel, stride, pad, pool in _STEM:
+                feats.add(Conv2D(width, kernel_size=kernel, strides=stride,
+                                 padding=pad, activation="relu"))
+                if pool:
+                    feats.add(MaxPool2D(pool_size=3, strides=2))
+            feats.add(Flatten())
+            for _ in range(2):
+                feats.add(Dense(_HEAD_WIDTH, activation="relu"))
+                feats.add(Dropout(_HEAD_DROP))
+            self.features = feats
             self.output = Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
-    """ref: alexnet.py alexnet."""
+    """Build AlexNet; optionally load zoo weights."""
     net = AlexNet(**kwargs)
     if pretrained:
         from ..model_store import get_model_file

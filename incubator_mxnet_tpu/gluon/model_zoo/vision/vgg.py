@@ -1,4 +1,12 @@
-"""VGG 11/13/16/19 ± batch-norm (ref: python/mxnet/gluon/model_zoo/vision/vgg.py)."""
+"""VGG 11/13/16/19 (± batch-norm) for the TPU model zoo.
+
+Stage layout follows Simonyan & Zisserman (1409.1556, configs A/B/D/E).
+API and checkpoint-key parity with the reference zoo (ref:
+python/mxnet/gluon/model_zoo/vision/vgg.py) is asserted by
+``tests/test_model_zoo_rewrite.py``.  The whole family — features,
+classifier head, and the eight factory functions — is stamped out from
+``vgg_spec`` by loops rather than per-depth classes.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock
@@ -9,98 +17,65 @@ from .... import initializer
 __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "vgg11_bn", "vgg13_bn",
            "vgg16_bn", "vgg19_bn", "get_vgg"]
 
+# depth -> (conv repeats per stage, stage widths)
+vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+            13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+            16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+            19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
 
 class VGG(HybridBlock):
-    """ref: vgg.py class VGG."""
+    """Plain conv stack: per stage, ``reps`` 3×3 convs then a 2× max-pool;
+    two dropout-regularised 4096-wide Dense layers feed the classifier."""
 
     def __init__(self, layers, filters, classes=1000, batch_norm=False,
                  **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(filters)
         with self.name_scope():
-            self.features = self._make_features(layers, filters, batch_norm)
-            self.features.add(Dense(4096, activation="relu",
-                                    weight_initializer="normal",
-                                    bias_initializer="zeros"))
-            self.features.add(Dropout(rate=0.5))
-            self.features.add(Dense(4096, activation="relu",
-                                    weight_initializer="normal",
-                                    bias_initializer="zeros"))
-            self.features.add(Dropout(rate=0.5))
+            feats = HybridSequential(prefix="")
+            for reps, width in zip(layers, filters):
+                for _ in range(reps):
+                    feats.add(Conv2D(
+                        width, kernel_size=3, padding=1,
+                        weight_initializer=initializer.Xavier(
+                            rnd_type="gaussian", factor_type="out",
+                            magnitude=2),
+                        bias_initializer="zeros"))
+                    if batch_norm:
+                        feats.add(BatchNorm())
+                    feats.add(Activation("relu"))
+                feats.add(MaxPool2D(strides=2))
+            for _ in range(2):
+                feats.add(Dense(4096, activation="relu",
+                                weight_initializer="normal",
+                                bias_initializer="zeros"))
+                feats.add(Dropout(rate=0.5))
+            self.features = feats
             self.output = Dense(classes, weight_initializer="normal",
                                 bias_initializer="zeros")
 
-    def _make_features(self, layers, filters, batch_norm):
-        featurizer = HybridSequential(prefix="")
-        for i, num in enumerate(layers):
-            for _ in range(num):
-                featurizer.add(Conv2D(filters[i], kernel_size=3, padding=1,
-                                      weight_initializer=initializer.Xavier(
-                                          rnd_type="gaussian",
-                                          factor_type="out", magnitude=2),
-                                      bias_initializer="zeros"))
-                if batch_norm:
-                    featurizer.add(BatchNorm())
-                featurizer.add(Activation("relu"))
-            featurizer.add(MaxPool2D(strides=2))
-        return featurizer
-
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
-
-
-vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
-            13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
-            16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
-            19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
+        return self.output(self.features(x))
 
 
 def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
-    """ref: vgg.py get_vgg."""
+    """Build a VGG by depth; optionally load zoo weights."""
     layers, filters = vgg_spec[num_layers]
     net = VGG(layers, filters, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
-        batch_norm_suffix = "_bn" if kwargs.get("batch_norm") else ""
-        net.load_params(get_model_file("vgg%d%s" % (num_layers,
-                                                    batch_norm_suffix),
+        suffix = "_bn" if kwargs.get("batch_norm") else ""
+        net.load_params(get_model_file("vgg%d%s" % (num_layers, suffix),
                                        root=root), ctx=ctx)
     return net
 
 
-def vgg11(**kwargs):
-    return get_vgg(11, **kwargs)
+from ._factories import stamp_factory  # noqa: E402
 
-
-def vgg13(**kwargs):
-    return get_vgg(13, **kwargs)
-
-
-def vgg16(**kwargs):
-    return get_vgg(16, **kwargs)
-
-
-def vgg19(**kwargs):
-    return get_vgg(19, **kwargs)
-
-
-def vgg11_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(11, **kwargs)
-
-
-def vgg13_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(13, **kwargs)
-
-
-def vgg16_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(16, **kwargs)
-
-
-def vgg19_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(19, **kwargs)
+for _depth in sorted(vgg_spec):
+    stamp_factory(globals(), "vgg%d" % _depth,
+                  "VGG-%d from vgg_spec." % _depth, get_vgg, _depth)
+    stamp_factory(globals(), "vgg%d_bn" % _depth,
+                  "VGG-%d with batch normalisation." % _depth,
+                  get_vgg, _depth, batch_norm=True)
+del _depth

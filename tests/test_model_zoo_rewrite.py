@@ -1,0 +1,63 @@
+"""Checkpoint-key parity for the spec-driven model-zoo rewrite.
+
+The vision zoo was restructured (round 4) from hand-unrolled per-block
+classes into declarative builders.  These tests pin the public surface to
+a snapshot of prefix-stripped parameter names recorded from the original
+implementation (``tests/data/zoo_param_names.json``), which is exactly the
+key set ``save_params`` writes — so any checkpoint saved before the
+rewrite still loads.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+_SNAP = json.load(open(os.path.join(os.path.dirname(__file__), "data",
+                                    "zoo_param_names.json")))
+
+
+@pytest.mark.parametrize("factory", sorted(_SNAP))
+def test_param_names_match_snapshot(factory):
+    net = getattr(vision, factory)()
+    prefix = net.prefix
+    got = sorted(k[len(prefix):] for k in net.collect_params().keys())
+    assert got == _SNAP[factory]
+
+
+def test_resnet_spec_wiring():
+    # bottleneck depths really produce bottleneck blocks and v2 pre-acts
+    net = vision.resnet50_v2(thumbnail=True, classes=4)
+    blocks = [b for stage in net.features._children
+              for b in getattr(stage, "_children", [])
+              if isinstance(b, vision.resnet._ResidualUnit)]
+    assert len(blocks) == sum(vision.resnet.resnet_spec[50][1])
+    assert all(isinstance(b, vision.resnet.BottleneckV2) for b in blocks)
+
+
+def test_checkpoint_roundtrip_after_rewrite(tmp_path):
+    net = vision.resnet18_v1(thumbnail=True, classes=7)
+    x = mx.nd.array(np.random.RandomState(3).standard_normal(
+        (1, 3, 32, 32)).astype("float32"))
+    net.initialize()
+    net(x)
+    path = str(tmp_path / "r18.params")
+    net.save_params(path)
+
+    net2 = vision.resnet18_v1(thumbnail=True, classes=7)
+    net2.load_params(path)
+    np.testing.assert_allclose(net(x).asnumpy(), net2(x).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_vgg_bn_param_count_scales():
+    # batch_norm=True adds exactly 4 BN params per conv
+    for depth in (11, 16):
+        plain = getattr(vision, "vgg%d" % depth)()
+        bn = getattr(vision, "vgg%d_bn" % depth)()
+        n_convs = sum(vision.vgg.vgg_spec[depth][0])
+        assert (len(list(bn.collect_params().keys()))
+                - len(list(plain.collect_params().keys())) == 4 * n_convs)
