@@ -121,12 +121,19 @@ class CSRNDArray(BaseSparseNDArray):
         DataParallelExecutorGroup splitting a LibSVMIter batch across
         contexts)."""
         if isinstance(key, int):
+            if key < 0:
+                key += self._shape[0]
+            if not 0 <= key < self._shape[0]:
+                raise IndexError(
+                    "index %r is out of bounds for axis 0 with size %d"
+                    % (key, self._shape[0]))
             key = slice(key, key + 1)
         if not isinstance(key, slice) or key.step not in (None, 1):
             raise ValueError(
                 "CSRNDArray only supports contiguous row slicing, got %r"
                 % (key,))
         start, stop, _ = key.indices(self._shape[0])
+        stop = max(start, stop)  # empty, not negative-row-count, for csr[3:1]
         ptr = np.asarray(self.indptr._read())
         lo, hi = int(ptr[start]), int(ptr[stop])
         new_ptr = ptr[start:stop + 1] - ptr[start]

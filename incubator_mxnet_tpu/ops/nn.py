@@ -279,32 +279,48 @@ def _bn_train_core(data, gamma, beta, axis, eps, fix_gamma):
 
 
 def _bn_train_core_fwd_rule(data, gamma, beta, axis, eps, fix_gamma):
+    # symbolic_zeros=True wraps primal inputs in CustomVJPPrimal
+    data, gamma, beta = data.value, gamma.value, beta.value
     out, mean, var, inv, scale = _bn_train_core_fwd(data, gamma, beta, axis,
                                                     eps, fix_gamma)
     return (out, mean, var), (data, gamma, mean, inv, scale)
 
 
 def _bn_train_core_bwd_rule(axis, eps, fix_gamma, res, cotangents):
-    dy = cotangents[0]  # mean/var outputs feed the (undifferentiated)
-    # moving-average update only, mirroring the reference's aux states —
-    # their cotangents are structurally zero in every training graph
+    from jax.custom_derivatives import SymbolicZero
+    dy, ct_mean, ct_var = cotangents
     data, gamma, mean, inv, scale = res
     axis, red, bshape, m = _bn_reduce_layout(data, axis)
-    dyf = dy.astype(jnp.float32)
-    xhat = (data.astype(jnp.float32) - mean.reshape(bshape)) * \
-        inv.reshape(bshape)
-    # both reductions read (dy, x) once — XLA multi-output fuses them
-    dbeta = jnp.sum(dyf, axis=red)
-    dgamma_raw = jnp.sum(dyf * xhat, axis=red)
-    dx = (scale.reshape(bshape) *
-          (dyf - (dbeta.reshape(bshape) +
-                  xhat * dgamma_raw.reshape(bshape)) / m)).astype(data.dtype)
+    xc = data.astype(jnp.float32) - mean.reshape(bshape)
+    if isinstance(dy, SymbolicZero):
+        dx = jnp.zeros(data.shape, jnp.float32)
+        dgamma_raw = jnp.zeros_like(mean)
+        dbeta = jnp.zeros_like(mean)
+    else:
+        dyf = dy.astype(jnp.float32)
+        xhat = xc * inv.reshape(bshape)
+        # both reductions read (dy, x) once — XLA multi-output fuses them
+        dbeta = jnp.sum(dyf, axis=red)
+        dgamma_raw = jnp.sum(dyf * xhat, axis=red)
+        dx = scale.reshape(bshape) * \
+            (dyf - (dbeta.reshape(bshape) +
+                    xhat * dgamma_raw.reshape(bshape)) / m)
+    # Cotangents on the batch-statistics outputs (graphs that differentiate
+    # through output_mean_var) fold straight into dx: dmean/dx = 1/m and
+    # dvar/dx = 2(x-mean)/m (the cross-term through the mean cancels).  In
+    # ordinary training graphs they are SymbolicZero and cost nothing.
+    if not isinstance(ct_mean, SymbolicZero):
+        dx = dx + ct_mean.astype(jnp.float32).reshape(bshape) / m
+    if not isinstance(ct_var, SymbolicZero):
+        dx = dx + ct_var.astype(jnp.float32).reshape(bshape) * 2.0 * xc / m
+    dx = dx.astype(data.dtype)
     dgamma = (jnp.zeros_like(gamma) if fix_gamma
               else dgamma_raw.astype(gamma.dtype))
     return dx, dgamma, dbeta.astype(gamma.dtype)
 
 
-_bn_train_core.defvjp(_bn_train_core_fwd_rule, _bn_train_core_bwd_rule)
+_bn_train_core.defvjp(_bn_train_core_fwd_rule, _bn_train_core_bwd_rule,
+                      symbolic_zeros=True)
 
 
 @register("BatchNorm", num_inputs=5, num_outputs=3, num_visible_outputs=1,

@@ -146,19 +146,20 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
         matched_gt = gt[best_gt]                        # (A, 4)
         cls = jnp.where(pos, lab[best_gt, 0] + 1, 0.0)  # 0 = background
         if negative_mining_ratio > 0:
-            # hard-negative mining (multibox_target.cc): unmatched anchors
-            # whose max non-background confidence clears the threshold
-            # compete for ratio×num_pos background slots (>= the minimum);
-            # every other negative is marked ignore_label and must not
-            # reach the classification loss
+            # hard-negative mining (multibox_target.cc:216): unmatched
+            # anchors whose best IoU stays BELOW the thresh (near-positives
+            # are excluded from mining) compete for ratio×num_pos background
+            # slots (>= the minimum), hardest first — hardness is a LOW
+            # background softmax probability (the loss -log(bg_prob) the
+            # reference skips the log of); every other negative is marked
+            # ignore_label and must not reach the classification loss
             neg = ~pos
-            hard = (jnp.max(pred[1:], axis=0) if pred.shape[0] > 1
-                    else jnp.zeros((A,), pred.dtype))
-            cand = neg & (hard > negative_mining_thresh)
+            bg_prob = jax.nn.softmax(pred, axis=0)[0]    # (A,)
+            cand = neg & (best_iou < negative_mining_thresh)
             num_keep = jnp.maximum(
                 negative_mining_ratio * jnp.sum(pos),
                 float(minimum_negative_samples))
-            score = jnp.where(cand, hard, -jnp.inf)
+            score = jnp.where(cand, -bg_prob, -jnp.inf)  # hardest first
             order = jnp.argsort(-score)
             rank = jnp.zeros((A,), jnp.int32).at[order].set(
                 jnp.arange(A, dtype=jnp.int32))

@@ -68,17 +68,25 @@ def _switch_body(x, gw, w1, w2, *, axis, num_experts, cap):
     y = jnp.einsum("gch,ghd->gcd", h, w2)                # (E/P, P·C, d)
     y = lax.all_to_all(y, axis, split_axis=1, concat_axis=0,
                        tiled=True)                       # (E, C, d)
-    out = jnp.einsum("nec,ecd->nd", dispatch, y)
+    # Switch combine: scale by the selected expert's softmax probability so
+    # the gating logits stay differentiable (a bare one-hot combine would
+    # starve the router of gradient).
+    sel_prob = jnp.sum(probs * onehot, axis=-1, keepdims=True)
+    out = jnp.einsum("nec,ecd->nd", dispatch, y) * sel_prob
     dropped = 1.0 - jnp.sum(keep) / nloc
-    return out, dropped.reshape(1)
+    # Switch aux load-balance loss: E · Σ_e f_e·P_e (f = dispatch fraction,
+    # P = mean router prob); minimised by uniform routing.
+    aux = num_experts * jnp.sum(jnp.mean(onehot, axis=0)
+                                * jnp.mean(probs, axis=0))
+    return out, dropped.reshape(1), aux.reshape(1)
 
 
 def switch_moe_apply(x, gw, w1, w2, mesh, ep_axis="ep",
                      capacity_factor=1.25):
     """Capacity-dispatch MoE over ``mesh[ep_axis]``: returns
-    ``(out, drop_frac_per_device)``.  Tokens are sharded over the ep axis
-    for dispatch (N must divide by the axis size); expert weights arrive
-    sharded on their leading expert dim."""
+    ``(out, drop_frac_per_device, aux_loss_per_device)``.  Tokens are
+    sharded over the ep axis for dispatch (N must divide by the axis
+    size); expert weights arrive sharded on their leading expert dim."""
     num_experts = w1.shape[0]
     ep = mesh.shape[ep_axis]
     if x.shape[0] % ep:
@@ -94,7 +102,7 @@ def switch_moe_apply(x, gw, w1, w2, mesh, ep_axis="ep",
                           num_experts=num_experts, cap=cap),
         mesh=mesh,
         in_specs=(P(ep_axis), P(), P(ep_axis), P(ep_axis)),
-        out_specs=(P(ep_axis), P(ep_axis)),
+        out_specs=(P(ep_axis), P(ep_axis), P(ep_axis)),
         check_vma=False)
     return fn(x, gw, w1, w2)
 
@@ -125,6 +133,7 @@ class ExpertParallelMoE(HybridBlock):
         self._dispatch = dispatch
         self._capacity_factor = float(capacity_factor)
         self.last_drop_fraction = None  # updated on eager capacity calls
+        self._last_aux = None           # Switch load-balance loss, lazy
         with self.name_scope():
             self.gate_weight = self.params.get(
                 "gate_weight", shape=(0, num_experts),
@@ -164,21 +173,49 @@ class ExpertParallelMoE(HybridBlock):
 
         logits = xv @ gw                               # (N, E)
         probs = jax.nn.softmax(logits, axis=-1)
-        if self._top_k < self._num_experts:
+        if self._top_k == 1:
+            # Switch combine: raw selected probability (renormalising a
+            # single expert would collapse to 1.0 and starve the router
+            # of gradient)
+            onehot = jax.nn.one_hot(jnp.argmax(probs, axis=-1),
+                                    self._num_experts, dtype=xv.dtype)
+            combine = probs * onehot
+        elif self._top_k < self._num_experts:
             top_vals, _ = jax.lax.top_k(probs, self._top_k)
             thresh = top_vals[..., -1:]
             mask = probs >= thresh
             gated = jnp.where(mask, probs, 0.0)
-            # renormalize over the selected experts (Switch/Top-k combine)
+            # renormalize over the selected experts (top-k combine)
             combine = gated / jnp.maximum(
                 gated.sum(-1, keepdims=True), 1e-9)
         else:
             combine = probs
+        self._store_aux(combine, probs)
         # per-expert FFN, expert dim sharded: h[e] = relu(x @ W1[e]) @ W2[e]
         h = jax.nn.relu(jnp.einsum("nd,edh->neh", xv, w1))
         y = jnp.einsum("neh,ehd->ned", h, w2)
         out = jnp.einsum("ne,ned->nd", combine, y)
         return NDArray(out) if isinstance(x, NDArray) else out
+
+    @property
+    def last_aux_loss(self):
+        """Switch load-balance loss E·Σ f_e·P_e from the last eager call
+        (materialised lazily — reading it may sync with the device)."""
+        v = self._last_aux
+        return None if v is None else float(v)
+
+    @last_aux_loss.setter
+    def last_aux_loss(self, v):
+        self._last_aux = v
+
+    def _store_aux(self, combine, probs):
+        """Stash the load-balance loss on eager calls without forcing a
+        device->host sync on the forward path."""
+        if isinstance(probs, jax.core.Tracer):
+            return
+        frac = jnp.mean((combine > 0).astype(probs.dtype), axis=0)
+        self._last_aux = self._num_experts * jnp.sum(
+            frac * jnp.mean(probs, axis=0))
 
     def _capacity_forward(self, xv, gw, w1, w2):
         """Switch all-to-all dispatch over the scoped mesh's ep axis.
@@ -191,7 +228,7 @@ class ExpertParallelMoE(HybridBlock):
             raise ValueError("mesh %s has no axis %r for capacity dispatch"
                              % (mesh.axis_names, self._ep_axis))
         ep = self._ep_axis
-        (out, drops), eager = dispatch_on_mesh(
+        (out, drops, aux), eager = dispatch_on_mesh(
             lambda a, b, c, d: switch_moe_apply(a, b, c, d, mesh, ep,
                                                 self._capacity_factor),
             mesh, (P(ep), P(), P(ep), P(ep)), xv, gw, w1, w2)
@@ -201,5 +238,6 @@ class ExpertParallelMoE(HybridBlock):
                 # drops is a tracer — stats stay at their last value
                 self.last_drop_fraction = float(
                     np.mean(jax.device_get(drops)))
+                self.last_aux_loss = float(np.mean(jax.device_get(aux)))
             return gather_home(out, mesh)
         return out

@@ -176,11 +176,18 @@ def test_multibox_target_negative_mining():
     lt, lm, ct = nd.MultiBoxTarget(nd.array(anchors), nd.array(label),
                                    nd.array(cls_pred),
                                    negative_mining_ratio=3.0,
-                                   negative_mining_thresh=0.0)
+                                   negative_mining_thresh=0.5)
     c = ct.asnumpy()[0]
     assert (c == 1).sum() == 1                  # one positive (cls 0 -> 1)
     assert (c == 0).sum() == 3                  # 3x1 hard negatives kept
     assert (c == -1).sum() == 12                # the rest ignored
+    # hardness order: the kept negatives are the lowest-background-prob
+    # (most confidently wrong) candidates, per multibox_target.cc
+    e = np.exp(cls_pred[0] - cls_pred[0].max(0, keepdims=True))
+    bg = (e / e.sum(0))[0]
+    kept = set(np.where(c == 0)[0])
+    hardest = set(np.argsort(np.where(np.arange(16) == 0, np.inf, bg))[:3])
+    assert kept == hardest, (kept, hardest)
     # without mining every negative stays background
     _, _, ct2 = nd.MultiBoxTarget(nd.array(anchors), nd.array(label),
                                   nd.array(cls_pred))

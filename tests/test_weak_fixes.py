@@ -100,3 +100,51 @@ def test_row_sparse_pull_dense_out():
 def test_memory_stats_api():
     stats = mx.context.memory_stats(mx.cpu())
     assert isinstance(stats, dict)   # CPU backend may report no counters
+
+
+def test_csr_negative_and_oob_int_indexing():
+    """csr[-1] must address the last row; out-of-range ints raise
+    (advisor regression: slice(-1, 0) built a corrupt negative-row-count
+    CSRNDArray)."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    dense = np.arange(12, dtype=np.float32).reshape(4, 3)
+    dense[1] = 0
+    csr = mx.nd.sparse.csr_matrix(dense)
+    np.testing.assert_allclose(csr[-1].asnumpy(), dense[3:4])
+    np.testing.assert_allclose(csr[-4].asnumpy(), dense[0:1])
+    for bad in (4, -5):
+        try:
+            csr[bad]
+        except IndexError:
+            pass
+        else:
+            raise AssertionError("expected IndexError for %d" % bad)
+
+
+def test_batchnorm_stat_outputs_carry_gradient():
+    """Differentiating through the batch mean/var outputs must reach the
+    data (advisor regression: their cotangents were silently dropped)."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.randn(4, 3, 2, 2).astype(np.float32))
+    gamma = mx.nd.ones((3,))
+    beta = mx.nd.zeros((3,))
+    mmean = mx.nd.zeros((3,))
+    mvar = mx.nd.ones((3,))
+    x.attach_grad()
+    with autograd.record():
+        out, mean, var = mx.nd.BatchNorm(
+            x, gamma, beta, mmean, mvar, fix_gamma=False,
+            output_mean_var=True)
+        loss = (mean * mean).sum() + var.sum()
+    loss.backward()
+    g = x.grad.asnumpy()
+    xn = x.asnumpy()
+    m = xn.shape[0] * xn.shape[2] * xn.shape[3]
+    bmean = xn.mean(axis=(0, 2, 3), keepdims=True)
+    expect = 2.0 * bmean / m + 2.0 * (xn - bmean) / m
+    np.testing.assert_allclose(g, expect, rtol=1e-4, atol=1e-6)
