@@ -61,8 +61,10 @@ def main():
         mesh=mesh, dtype="bfloat16")
 
     rs = np.random.RandomState(0)
-    x = mx.nd.array((rs.rand(batch, seq_len) * vocab).astype(np.float32))
-    y = mx.nd.array((rs.rand(batch, seq_len) * vocab).astype(np.float32))
+    # int32 token ids stay exact through the trainer's mixed-precision
+    # input cast (bf16 would round large vocab ids); labels f32 for pick
+    x = mx.nd.array(rs.randint(0, vocab, (batch, seq_len)), dtype=np.int32)
+    y = mx.nd.array(rs.randint(0, vocab, (batch, seq_len)).astype(np.float32))
 
     for _ in range(3):
         loss = trainer.step(x, y)

@@ -1,0 +1,180 @@
+"""INT8 vs bf16/f32 op speed on the real chip — the TPU counterpart of the
+reference's quantized-op benchmark (ref: benchmark/python/quantization/
+benchmark_op.py:1-90).
+
+Times the framework's own op kernels (the fcomputes the nd/symbol front
+ends dispatch): ``Convolution``/``FullyConnected`` in bf16 and f32 vs
+``_contrib_quantized_conv``/``_contrib_quantized_fully_connected`` whose
+int8 operands lower to the MXU's s8×s8→s32 pipeline
+(ops/quantization.py:189, preferred_element_type=int32).
+
+Timing discipline (axon tunnel): ``block_until_ready`` does not reliably
+sync, so each measurement jits ONE program that scans the op N times with
+a data dependency between iterations and fetches a scalar — wall clock
+around the host fetch is true device time (same recipe as
+docs/perf_analysis_r03.md).
+
+Prints JSON lines; run with --fc for the FullyConnected sweep too.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "..")))
+
+import jax                                       # noqa: E402
+import jax.numpy as jnp                          # noqa: E402
+
+from incubator_mxnet_tpu.ops.registry import get_op  # noqa: E402
+
+REPEATS = 20
+
+# reference sweep (benchmark_op.py:73-89): resnet-style conv shapes
+CONV_CONFIGS = [
+    # (data_shape, kernel, num_filter, pad, stride)
+    ((32, 64, 56, 56), (1, 1), 256, (0, 0), (1, 1)),
+    ((32, 256, 56, 56), (1, 1), 64, (0, 0), (1, 1)),
+    ((32, 256, 56, 56), (1, 1), 128, (0, 0), (2, 2)),
+    ((32, 128, 28, 28), (3, 3), 128, (1, 1), (1, 1)),
+    ((32, 1024, 14, 14), (1, 1), 256, (0, 0), (1, 1)),
+    ((32, 2048, 7, 7), (1, 1), 512, (0, 0), (1, 1)),
+]
+
+FC_CONFIGS = [
+    # (batch, in_features, num_hidden)
+    (32, 2048, 1000),
+    (256, 2048, 1000),
+    (256, 4096, 4096),
+]
+
+
+def _timed_scan(fn, *args, repeats=REPEATS):
+    """Jit a scan of ``fn``; return ms/call.
+
+    Each iteration's inputs pass through an ``optimization_barrier`` tied
+    to the previous iteration's output, so XLA can neither hoist the
+    (otherwise loop-invariant) op out of the loop nor CSE the calls; the
+    final scalar fetch is the true sync point on the axon tunnel.
+    """
+    @jax.jit
+    def many(*a):
+        def body(carry, _):
+            out = fn(*carry)
+            lead = out[0] if isinstance(out, tuple) else out
+            probe = lead.reshape(-1)[0].astype(jnp.float32)
+            carry, probe = jax.lax.optimization_barrier((carry, probe))
+            return carry, probe
+        _, probes = jax.lax.scan(body, a, None, length=repeats)
+        return probes.sum()
+
+    try:
+        float(many(*args))      # compile + warm
+    except jax.errors.JaxRuntimeError:
+        # XLA's CPU backend mis-lowers some s8 ops inside scan (LLVM
+        # verifier failure); fall back to a per-call loop — fine off the
+        # axon tunnel where per-dispatch cost is microseconds.
+        one = jax.jit(lambda *a: (
+            (fn(*a)[0] if isinstance(fn(*a), tuple) else fn(*a))
+            .reshape(-1)[0].astype(jnp.float32)))
+        float(one(*args))
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            r = one(*args)
+        float(r)
+        return (time.perf_counter() - t0) / repeats * 1e3
+    t0 = time.perf_counter()
+    float(many(*args))          # host fetch = true sync
+    return (time.perf_counter() - t0) / repeats * 1e3
+
+
+def bench_conv(data_shape, kernel, num_filter, pad, stride):
+    rs = np.random.RandomState(0)
+    conv = get_op("Convolution").fcompute
+    qconv = get_op("_contrib_quantized_conv").fcompute
+    w_shape = (num_filter, data_shape[1]) + kernel
+    x32 = jnp.asarray(rs.normal(0, 0.2, data_shape), jnp.float32)
+    w32 = jnp.asarray(rs.normal(0, 1, w_shape), jnp.float32)
+
+    results = {}
+    for name, dt in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
+        x, w = x32.astype(dt), w32.astype(dt)
+        results[name] = _timed_scan(
+            lambda a, b: conv(a, b, None, kernel=kernel, stride=stride,
+                              pad=pad, num_filter=num_filter, no_bias=True),
+            x, w)
+
+    x8 = jnp.clip(jnp.rint(x32 / jnp.abs(x32).max() * 127), -127,
+                  127).astype(jnp.int8)
+    w8 = jnp.clip(jnp.rint(w32 / jnp.abs(w32).max() * 127), -127,
+                  127).astype(jnp.int8)
+    mn = jnp.float32(-1)
+    mx_ = jnp.float32(1)
+    results["int8"] = _timed_scan(
+        lambda a, b: qconv(a, b, mn, mx_, mn, mx_, kernel=kernel,
+                           stride=stride, pad=pad, num_filter=num_filter,
+                           no_bias=True),
+        x8, w8)
+    return results
+
+
+def bench_fc(batch, in_features, num_hidden):
+    rs = np.random.RandomState(0)
+    fc = get_op("FullyConnected").fcompute
+    qfc = get_op("_contrib_quantized_fully_connected").fcompute
+    x32 = jnp.asarray(rs.normal(0, 0.2, (batch, in_features)), jnp.float32)
+    w32 = jnp.asarray(rs.normal(0, 1, (num_hidden, in_features)), jnp.float32)
+
+    results = {}
+    for name, dt in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
+        x, w = x32.astype(dt), w32.astype(dt)
+        results[name] = _timed_scan(
+            lambda a, b: fc(a, b, num_hidden=num_hidden, no_bias=True),
+            x, w)
+
+    x8 = jnp.clip(jnp.rint(x32 * 127), -127, 127).astype(jnp.int8)
+    w8 = jnp.clip(jnp.rint(w32 / jnp.abs(w32).max() * 127), -127,
+                  127).astype(jnp.int8)
+    mn, mx_ = jnp.float32(-1), jnp.float32(1)
+    results["int8"] = _timed_scan(
+        lambda a, b: qfc(a, b, mn, mx_, mn, mx_, num_hidden=num_hidden,
+                         no_bias=True),
+        x8, w8)
+    return results
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--fc", action="store_true", help="include FC sweep")
+    p.add_argument("--conv", action="store_true", help="include conv sweep")
+    args = p.parse_args()
+    do_conv = args.conv or not args.fc
+    if do_conv:
+        for cfg in CONV_CONFIGS:
+            r = bench_conv(*cfg)
+            print(json.dumps({
+                "op": "conv", "data_shape": cfg[0], "kernel": cfg[1],
+                "num_filter": cfg[2], "stride": cfg[4],
+                "f32_ms": round(r["f32"], 3), "bf16_ms": round(r["bf16"], 3),
+                "int8_ms": round(r["int8"], 3),
+                "int8_vs_f32": round(r["f32"] / r["int8"], 2),
+                "int8_vs_bf16": round(r["bf16"] / r["int8"], 2),
+            }), flush=True)
+    if args.fc:
+        for cfg in FC_CONFIGS:
+            r = bench_fc(*cfg)
+            print(json.dumps({
+                "op": "fc", "batch": cfg[0], "in": cfg[1], "hidden": cfg[2],
+                "f32_ms": round(r["f32"], 3), "bf16_ms": round(r["bf16"], 3),
+                "int8_ms": round(r["int8"], 3),
+                "int8_vs_f32": round(r["f32"] / r["int8"], 2),
+                "int8_vs_bf16": round(r["bf16"] / r["int8"], 2),
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

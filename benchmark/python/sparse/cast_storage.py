@@ -1,0 +1,69 @@
+"""cast_storage throughput — TPU counterpart of the reference's
+cast-storage benchmark (ref: benchmark/python/sparse/cast_storage.py:1).
+
+dense->csr / dense->row_sparse and back, timed per call on the eager
+surface (these are host+device hybrid conversions in the TPU build:
+nonzero scans run as XLA programs, index bookkeeping on host —
+ndarray/sparse.py cast_storage).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "..")))
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+
+CONFIGS = [
+    # (rows, cols, density)
+    (512, 8192, 0.01),
+    (2048, 8192, 0.01),
+    (8192, 8192, 0.001),
+    (8192, 512, 0.05),
+]
+
+
+def measure(f, repeat=10):
+    f()
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        f()
+    return (time.perf_counter() - t0) / repeat
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--repeat", type=int, default=10)
+    args = p.parse_args()
+    rs = np.random.RandomState(0)
+    for rows, cols, density in CONFIGS:
+        dense_np = np.zeros((rows, cols), np.float32)
+        nnz = int(rows * cols * density)
+        dense_np[rs.randint(0, rows, nnz), rs.randint(0, cols, nnz)] = 1.0
+        dense = mx.nd.array(dense_np)
+        csr = mx.nd.sparse.cast_storage(dense, "csr")
+        rsp = mx.nd.sparse.cast_storage(dense, "row_sparse")
+
+        out = {
+            "op": "cast_storage", "shape": [rows, cols], "density": density,
+            "dense_to_csr_ms": round(measure(
+                lambda: mx.nd.sparse.cast_storage(dense, "csr"),
+                args.repeat) * 1e3, 3),
+            "dense_to_rsp_ms": round(measure(
+                lambda: mx.nd.sparse.cast_storage(dense, "row_sparse"),
+                args.repeat) * 1e3, 3),
+            "csr_to_dense_ms": round(measure(
+                lambda: csr.todense(), args.repeat) * 1e3, 3),
+            "rsp_to_dense_ms": round(measure(
+                lambda: rsp.todense(), args.repeat) * 1e3, 3),
+        }
+        print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""Sparse dot throughput — TPU counterpart of the reference's sparse dot
+benchmark (ref: benchmark/python/sparse/dot.py:1).
+
+Measures ``mx.nd.sparse.dot`` for csr·dense at the reference's density
+sweep.  On TPU sparse compute lowers to gather/segment-sum XLA programs
+(ndarray/sparse.py) — there is no hand-written SpMV kernel to race, so
+the interesting numbers are effective GFLOP/s (counting nnz MACs) and
+the crossover vs a plain dense matmul of the same logical shape.
+
+Prints JSON lines.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "..")))
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+
+CONFIGS = [
+    # (m, k, n, density) — reference sweep shapes (dot.py:226-239 style)
+    (512, 3200, 512, 0.01),
+    (512, 3200, 512, 0.05),
+    (2048, 10000, 256, 0.01),
+    (2048, 10000, 256, 0.001),
+    (8192, 100000, 64, 0.001),
+]
+
+
+def _rand_csr(rs, m, k, density):
+    dense = np.zeros((m, k), np.float32)
+    nnz = int(m * k * density)
+    rows = rs.randint(0, m, nnz)
+    cols = rs.randint(0, k, nnz)
+    dense[rows, cols] = rs.randn(nnz).astype(np.float32)
+    return mx.nd.sparse.csr_matrix(dense), dense
+
+
+def measure(f, repeat=10):
+    out = f()
+    out.wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        out = f()
+        out.wait_to_read()
+    return (time.perf_counter() - t0) / repeat
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--repeat", type=int, default=10)
+    args = p.parse_args()
+    rs = np.random.RandomState(0)
+    for m, k, n, density in CONFIGS:
+        csr, dense_np = _rand_csr(rs, m, k, density)
+        rhs = mx.nd.array(rs.randn(k, n).astype(np.float32))
+        dense_lhs = mx.nd.array(dense_np)
+
+        t_sp = measure(lambda: mx.nd.sparse.dot(csr, rhs), args.repeat)
+        t_dn = measure(lambda: mx.nd.dot(dense_lhs, rhs), args.repeat)
+        nnz = csr.data.shape[0]
+        print(json.dumps({
+            "op": "csr_dot_dense", "shape": [m, k, n], "density": density,
+            "sparse_ms": round(t_sp * 1e3, 3),
+            "dense_ms": round(t_dn * 1e3, 3),
+            "effective_gflops": round(2 * nnz * n / t_sp / 1e9, 2),
+            "dense_gflops": round(2 * m * k * n / t_dn / 1e9, 2),
+            "sparse_vs_dense": round(t_dn / t_sp, 2),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

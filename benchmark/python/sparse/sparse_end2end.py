@@ -1,0 +1,81 @@
+"""End-to-end sparse-linear-model training throughput — TPU counterpart of
+the reference's sparse end-to-end benchmark (ref: benchmark/python/sparse/
+sparse_end2end.py:1, the linear-classification workload with CSR batches,
+row-sparse gradients, and lazy updates).
+
+Workload: logistic regression over a dim-D sparse feature space.  Each
+step: CSR batch -> sparse.dot forward -> row-sparse gradient (only the
+features the batch touches) -> lazy SGD update.  Reports samples/sec.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "..")))
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import nd  # noqa: E402
+from incubator_mxnet_tpu.ndarray.sparse import RowSparseNDArray  # noqa: E402
+
+
+def make_batches(rs, n_batches, batch, dim, nnz):
+    batches = []
+    for _ in range(n_batches):
+        dense = np.zeros((batch, dim), np.float32)
+        for i in range(batch):
+            cols = rs.choice(dim, size=nnz, replace=False)
+            dense[i, cols] = rs.randn(nnz).astype(np.float32)
+        y = (rs.rand(batch) > 0.5).astype(np.float32) * 2 - 1
+        batches.append((mx.nd.sparse.csr_matrix(dense), dense,
+                        mx.nd.array(y)))
+    return batches
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument("--dim", type=int, default=100000)
+    p.add_argument("--nnz", type=int, default=64)
+    p.add_argument("--steps", type=int, default=20)
+    args = p.parse_args()
+    rs = np.random.RandomState(0)
+    batches = make_batches(rs, 4, args.batch_size, args.dim, args.nnz)
+    w = mx.nd.zeros((args.dim, 1))
+    opt = mx.optimizer.SGD(learning_rate=0.1, lazy_update=True)
+
+    def step(i):
+        csr, dense_np, y = batches[i % len(batches)]
+        scores = mx.nd.sparse.dot(csr, w).reshape((-1,))
+        margin = scores * y
+        # logistic grad d/ds -log(sigmoid(margin)) = -y*sigmoid(-margin)
+        coef = -(y / (1 + mx.nd.exp(margin)))
+        # row-sparse grad: only the feature rows this batch touches
+        touched = np.unique(csr.indices.asnumpy().astype(np.int64))
+        gw_dense = mx.nd.dot(mx.nd.array(dense_np).T,
+                             coef.reshape((-1, 1))) / args.batch_size
+        gvals = mx.nd.array(gw_dense.asnumpy()[touched])
+        grad = RowSparseNDArray(gvals, mx.nd.array(touched),
+                                (args.dim, 1))
+        opt.update(0, w, grad, None)
+        w.wait_to_read()
+
+    step(0)  # warm-up
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        step(i)
+    dt = time.perf_counter() - t0
+    sps = args.steps * args.batch_size / dt
+    print(json.dumps({
+        "metric": "sparse_linear_train_samples_per_sec",
+        "value": round(sps, 1), "unit": "samples/s",
+        "batch": args.batch_size, "dim": args.dim, "nnz": args.nnz,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

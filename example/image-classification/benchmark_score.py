@@ -1,0 +1,93 @@
+"""Inference benchmark across the model zoo — the TPU counterpart of the
+reference's scoring sweep (ref: example/image-classification/
+benchmark_score.py:1-66, numbers in docs/faq/perf.md:122-144).
+
+The TPU-native inference path: a hybridized Gluon zoo model — the whole
+forward compiles to ONE XLA program via CachedOp — driven batch after
+batch with a device sync per batch (``wait_to_read``, the reference's
+``output.wait_to_read()`` shape).  bf16 by default: inference has no
+master-weight concern and the MXU doubles bf16 throughput.
+
+Usage:
+    python benchmark_score.py                  # full sweep, JSON lines
+    python benchmark_score.py --network resnet-50 --batch-size 32
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu.gluon.model_zoo import vision  # noqa: E402
+
+# network name (reference spelling) -> (zoo factory, input size)
+NETWORKS = {
+    "alexnet": ("alexnet", 224),
+    "vgg-16": ("vgg16", 224),
+    "inception-v3": ("inception_v3", 299),
+    "resnet-50": ("resnet50_v1", 224),
+    "resnet-152": ("resnet152_v1", 224),
+    "mobilenet-1.0": ("mobilenet1_0", 224),
+    "densenet-121": ("densenet121", 224),
+    "squeezenet-1.0": ("squeezenet1_0", 224),
+}
+
+
+def score(network, batch_size, num_batches=10, dtype="bfloat16"):
+    """img/s for one (network, batch) point; warm-up excluded."""
+    factory, size = NETWORKS[network]
+    mx.random.seed(0)
+    net = getattr(vision, factory)(classes=1000)
+    net.initialize(mx.init.Xavier(magnitude=2.0))
+    if dtype not in ("float32", "none", None):
+        net.cast(dtype)
+    net.hybridize()
+
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.uniform(-1, 1, (batch_size, 3, size, size))
+                    .astype(np.float32))
+    if dtype not in ("float32", "none", None):
+        x = x.astype(dtype)
+
+    for _ in range(5):                     # warm-up (includes compile)
+        out = net(x)
+    out.wait_to_read()
+
+    t0 = time.perf_counter()
+    for _ in range(num_batches):
+        out = net(x)
+        out.wait_to_read()                 # per-batch sync, reference shape
+    dt = time.perf_counter() - t0
+    return num_batches * batch_size / dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--network", default=None,
+                   help="one of %s (default: all)" % ", ".join(NETWORKS))
+    p.add_argument("--batch-size", type=int, default=0,
+                   help="single batch size (default: sweep 1 and 32)")
+    p.add_argument("--num-batches", type=int, default=10)
+    p.add_argument("--dtype", default="bfloat16")
+    args = p.parse_args()
+
+    networks = [args.network] if args.network else list(NETWORKS)
+    batches = [args.batch_size] if args.batch_size else [1, 32]
+    for network in networks:
+        for b in batches:
+            img_s = score(network, b, args.num_batches, args.dtype)
+            print(json.dumps({
+                "metric": "inference_imgs_per_sec", "network": network,
+                "batch_size": b, "value": round(img_s, 2), "unit": "img/s",
+                "dtype": args.dtype,
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

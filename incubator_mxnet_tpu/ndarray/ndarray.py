@@ -695,7 +695,13 @@ def empty(shape, ctx=None, dtype="float32"):
     ctx = ctx if ctx is not None else current_context()
     if isinstance(shape, int):
         shape = (shape,)
-    data = jax.device_put(jnp.zeros(shape, jnp.dtype(dtype)), ctx.jax_device())
+    # Allocate directly ON the target device.  jnp.zeros would materialize
+    # on the default device first and device_put would then bounce the
+    # buffer through the host — for a cpu-ctx scratch array (parameter
+    # init) that is an accelerator->host download of the full tensor per
+    # call, measured in minutes for ~1B params over the axon tunnel.
+    with jax.default_device(ctx.jax_device()):
+        data = jnp.zeros(shape, jnp.dtype(dtype))
     return NDArray(data, ctx=ctx)
 
 

@@ -232,7 +232,10 @@ def _embedding(data, weight, input_dim=0, output_dim=0, dtype="float32", sparse_
     reference maps to the sparse module's row-sparse grad path.
     """
     idx = data.astype(jnp.int32)
-    return jnp.take(weight, idx, axis=0)
+    # clip, not fill: jnp.take's NaN-fill default turns one rounded-up
+    # index (e.g. a bf16-cast token id at the vocab edge) into a NaN row
+    # that poisons the whole step; the reference clamps too
+    return jnp.take(weight, idx, axis=0, mode="clip")
 
 
 @register("one_hot", num_inputs=1, differentiable=False)

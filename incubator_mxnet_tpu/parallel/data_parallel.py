@@ -218,10 +218,15 @@ class DataParallelTrainer(object):
                 all_vals.update({n: v.astype(cdt)
                                  if v.dtype == jnp.float32 else v
                                  for n, v in trainable_vals.items()})
-                # f32 inputs AND integer images (uint8 data pipeline):
-                # the cast runs on device, keeping host batches cast-free
-                if x.dtype == jnp.float32 or jnp.issubdtype(x.dtype,
-                                                            jnp.integer):
+                # f32 inputs AND narrow-integer images (uint8/int16 data
+                # pipelines) cast on device, keeping host batches
+                # cast-free.  int32/int64 inputs are index data (token
+                # ids for Embedding) and must NOT be rounded through the
+                # compute dtype — bf16 resolves only 256 values per
+                # binade, so large vocab ids would land on multiples of
+                # 64 (and the top id past the table).
+                if x.dtype == jnp.float32 or x.dtype in (
+                        jnp.uint8, jnp.int8, jnp.uint16, jnp.int16):
                     x = x.astype(cdt)
             else:
                 all_vals.update(trainable_vals)

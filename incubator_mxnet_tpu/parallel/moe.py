@@ -210,10 +210,14 @@ class ExpertParallelMoE(HybridBlock):
 
     def _store_aux(self, combine, probs):
         """Stash the load-balance loss on eager calls without forcing a
-        device->host sync on the forward path."""
+        device->host sync on the forward path.  Dispatch fraction uses the
+        top-1 choice (GShard convention) so the stat stays meaningful even
+        for soft routing, where every combine entry is nonzero."""
         if isinstance(probs, jax.core.Tracer):
             return
-        frac = jnp.mean((combine > 0).astype(probs.dtype), axis=0)
+        top = jnp.argmax(probs, axis=-1)
+        frac = jnp.mean(jax.nn.one_hot(top, self._num_experts,
+                                       dtype=probs.dtype), axis=0)
         self._last_aux = self._num_experts * jnp.sum(
             frac * jnp.mean(probs, axis=0))
 

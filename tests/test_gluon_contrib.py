@@ -52,3 +52,38 @@ def test_variational_dropout_shares_mask_across_steps():
     # inference mode: dropout inactive → no exact zeros from masking
     outs3, _ = vd.unroll(3, x, merge_outputs=False)
     assert (outs3[0].asnumpy() == 0).sum() == 0
+
+
+def test_multihead_attention_fused_qkv_matches_unfused():
+    """fused_qkv=True (one (E,3E) projection) must compute the same
+    attention as three separate projections with the same weights."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.gluon import nn
+
+    E, H, B, S = 16, 4, 2, 8
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.randn(B, S, E).astype(np.float32))
+
+    mx.random.seed(0)
+    fused = nn.MultiHeadAttention(E, H, causal=True, use_bias=False,
+                                  fused_qkv=True)
+    fused.initialize(mx.init.Xavier())
+    fused(x)  # shapes
+
+    unfused = nn.MultiHeadAttention(E, H, causal=True, use_bias=False)
+    unfused.initialize(mx.init.Xavier())
+    unfused(x)
+
+    w = fused.proj_qkv.weight.data().asnumpy()      # (3E, E)
+    unfused.proj_q.weight.set_data(mx.nd.array(w[:E]))
+    unfused.proj_k.weight.set_data(mx.nd.array(w[E:2 * E]))
+    unfused.proj_v.weight.set_data(mx.nd.array(w[2 * E:]))
+    unfused.proj_out.weight.set_data(fused.proj_out.weight.data())
+
+    np.testing.assert_allclose(fused(x).asnumpy(), unfused(x).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+    import pytest
+    with pytest.raises(ValueError, match="self-attention"):
+        fused(x, x)

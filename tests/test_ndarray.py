@@ -225,3 +225,14 @@ def test_random_moments():
     mx.random.seed(42)
     b = mx.nd.random.uniform(shape=(5,)).asnumpy()
     assert np.array_equal(a, b)
+
+
+def test_empty_allocates_on_target_device():
+    """nd.empty(ctx=cpu) must not bounce through the default device (a
+    per-parameter accelerator->host download during init at scale)."""
+    import incubator_mxnet_tpu as mx
+    a = mx.nd.empty((4, 5), ctx=mx.cpu(0))
+    dev = a._read().sharding.device_set
+    assert all(d.platform == "cpu" for d in dev)
+    assert a.shape == (4, 5)
+    assert float(a.asnumpy().sum()) == 0.0
