@@ -38,10 +38,11 @@ _PEAK_TFLOPS = {
 }
 
 
-def model_flops_per_step(n_params, tokens, seq_len, d_model):
+def model_flops_per_step(n_params, tokens, seq_len, d_model, n_layers=1):
     """PaLM-style model FLOPs for one train step (fwd+bwd)."""
     dense = 6.0 * n_params * tokens
-    attn = 6.0 * seq_len * tokens * d_model  # causal: 0.5 * 12 * S * T * d
+    # per-LAYER causal attention matmuls: 0.5 * 12 * S * T * d
+    attn = 6.0 * seq_len * tokens * d_model * n_layers
     return dense + attn
 
 
@@ -170,7 +171,8 @@ def main():
                        for p in net.collect_params().values()))
     tokens = batch * seq_len
     tok_s = n_steps * tokens / dt
-    flops = model_flops_per_step(n_params, tokens, seq_len, d_model)
+    flops = model_flops_per_step(n_params, tokens, seq_len, d_model,
+                                 n_layers)
     achieved_tflops = flops * n_steps / dt / 1e12
     kind = jax.devices()[0].device_kind
     peak = float(os.environ.get("BENCH_PEAK_TFLOPS",

@@ -53,7 +53,7 @@ FC_CONFIGS = [
 ]
 
 
-def _timed_scan(fn, *args, repeats=REPEATS):
+def _timed_scan(fn, *args, repeats=None):
     """Jit a scan of ``fn``; return ms/call.
 
     Each iteration's inputs pass through an ``optimization_barrier`` tied
@@ -61,6 +61,9 @@ def _timed_scan(fn, *args, repeats=REPEATS):
     (otherwise loop-invariant) op out of the loop nor CSE the calls; the
     final scalar fetch is the true sync point on the axon tunnel.
     """
+    if repeats is None:
+        repeats = REPEATS   # read at call time so tests can shrink it
+
     @jax.jit
     def many(*a):
         def body(carry, _):

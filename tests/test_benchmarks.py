@@ -97,6 +97,6 @@ def test_inference_score_bench(capsys):
 
 def test_transformer_bench_flops_model():
     mod = _load("bench_transformer.py", "bench_tf")
-    # 6*N*T + 6*S*T*d
-    got = mod.model_flops_per_step(100, 10, 4, 8)
-    assert got == 6 * 100 * 10 + 6 * 4 * 10 * 8
+    # 6*N*T + L * 6*S*T*d (attention term is per layer)
+    got = mod.model_flops_per_step(100, 10, 4, 8, n_layers=3)
+    assert got == 6 * 100 * 10 + 3 * 6 * 4 * 10 * 8

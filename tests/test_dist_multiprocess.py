@@ -188,7 +188,7 @@ _TRAIN_WORKER = _PRELUDE + textwrap.dedent("""
 """)
 
 
-def _launch_two(tmp_path, source, timeout=300):
+def _launch_two(tmp_path, source, timeout=300, n=2, port_base=9300):
     worker = tmp_path / "worker.py"
     worker.write_text(source)
     repo = os.path.join(os.path.dirname(__file__), "..")
@@ -196,10 +196,10 @@ def _launch_two(tmp_path, source, timeout=300):
     env["PYTHONPATH"] = os.path.abspath(repo) + os.pathsep + \
         env.get("PYTHONPATH", "")
     env.pop("XLA_FLAGS", None)
-    port = 9300 + os.getpid() % 500      # avoid collisions between runs
+    port = port_base + os.getpid() % 500  # avoid collisions between runs
     proc = subprocess.Popen(
         [sys.executable, os.path.join(repo, "tools", "launch.py"),
-         "-n", "2", "-p", str(port), sys.executable, str(worker)],
+         "-n", str(n), "-p", str(port), sys.executable, str(worker)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True, start_new_session=True)
     try:
@@ -209,7 +209,8 @@ def _launch_two(tmp_path, source, timeout=300):
         # kill the whole process group so the workers don't leak
         os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
         proc.wait()
-        pytest.fail("2-process dist run deadlocked (%ds timeout)" % timeout)
+        pytest.fail("%d-process dist run deadlocked (%ds timeout)"
+                    % (n, timeout))
     out = stdout + stderr
     assert proc.returncode == 0, out[-3000:]
     return out
@@ -231,3 +232,33 @@ def test_two_process_end_to_end_training(tmp_path):
     for rank in (0, 1):
         for tag in ("FIT", "TRAINER", "DPT"):
             assert "WORKER %d %s OK" % (rank, tag) in out, out[-3000:]
+
+
+_COMPRESS4_WORKER = _PRELUDE + textwrap.dedent("""
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == 4, nw
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("cw", nd.zeros((37,)))     # odd length: exercises word padding
+    # rank r pushes 0.3*(r+1): q = {0, 0.5, 0.5, 0.5}, residuals kept
+    kv.push("cw", nd.ones((37,)) * (0.3 * (rank + 1)))
+    out = nd.zeros((37,))
+    kv.pull("cw", out=out)
+    assert np.allclose(out.asnumpy(), 1.5), out.asnumpy()
+    # second push: acc = residual + new = {0.6, 0.7, 1.3, 1.9}; the 2-bit
+    # code takes ONE +-t step per push -> q = 0.5 everywhere -> sum 2.0
+    kv.push("cw", nd.ones((37,)) * (0.3 * (rank + 1)))
+    kv.pull("cw", out=out)
+    assert np.allclose(out.asnumpy(), 2.0), out.asnumpy()
+    print("WORKER %d COMPRESS4 OK" % rank, flush=True)
+""")
+
+
+def test_four_process_compressed_wire(tmp_path):
+    """W=4 compressed reduce: the scale-correct wire (compressed
+    reduce-scatter + int8 sum gather) must keep the exact residual
+    algebra beyond the W=2 case the old allgather wire was tested at."""
+    out = _launch_two(tmp_path, _COMPRESS4_WORKER, timeout=300, n=4,
+                      port_base=9800)
+    for rank in range(4):
+        assert "WORKER %d COMPRESS4 OK" % rank in out, out[-3000:]
