@@ -91,6 +91,39 @@ def main():
     dt_bulk = time.perf_counter() - t0
     bulk_ops = CHAIN * ITERS / dt_bulk
 
+    # -- TRAINING variant: record() + backward() inside the scope --------
+    # (the reference's primary bulking target, MXNET_EXEC_BULK_EXEC_TRAIN:
+    # the recorded chain becomes one replay + ONE segment-vjp dispatch)
+    from incubator_mxnet_tpu import autograd
+
+    def _train_step(bulked):
+        import contextlib
+        scope = mx.engine.bulk(CHAIN + 8) if bulked \
+            else contextlib.nullcontext()
+        with scope:
+            with autograd.record():
+                out = _chain_eager(a, b, c, CHAIN)
+                loss = (out * out).sum()
+            loss.backward()
+        return loss
+
+    a.attach_grad()
+    _train_step(False).asnumpy()        # warm per-op caches
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss = _train_step(False)
+    loss.asnumpy()
+    dt_train_eager = time.perf_counter() - t0
+
+    _train_step(True).asnumpy()         # compile replay + segment vjp
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss = _train_step(True)
+    loss.asnumpy()
+    dt_train_bulk = time.perf_counter() - t0
+    train_eager_ops = CHAIN * ITERS / dt_train_eager
+    train_bulk_ops = CHAIN * ITERS / dt_train_bulk
+
     print(json.dumps({
         "metric": "eager_small_op_dispatch",
         "backend": backend,
@@ -100,6 +133,9 @@ def main():
         "hybridized_ops_per_sec": round(bulk_ops, 1),
         "engine_bulk_speedup": round(bulkscope_ops / eager_ops, 2),
         "hybridize_speedup": round(bulk_ops / eager_ops, 2),
+        "train_eager_ops_per_sec": round(train_eager_ops, 1),
+        "train_bulk_ops_per_sec": round(train_bulk_ops, 1),
+        "train_bulk_speedup": round(train_bulk_ops / train_eager_ops, 2),
     }))
 
 

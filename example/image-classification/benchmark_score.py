@@ -4,9 +4,12 @@ benchmark_score.py:1-66, numbers in docs/faq/perf.md:122-144).
 
 The TPU-native inference path: a hybridized Gluon zoo model — the whole
 forward compiles to ONE XLA program via CachedOp — driven batch after
-batch with a device sync per batch (``wait_to_read``, the reference's
-``output.wait_to_read()`` shape).  bf16 by default: inference has no
-master-weight concern and the MXU doubles bf16 throughput.
+batch.  Sync discipline: the device stream executes dispatches in order,
+so a host fetch of (one element of) the LAST batch's output bounds the
+whole timed region; ``wait_to_read``/``block_until_ready`` alone does
+not reliably synchronize through the axon tunnel (bench.py discipline).
+bf16 by default: inference has no master-weight concern and the MXU
+doubles bf16 throughput.
 
 Usage:
     python benchmark_score.py                  # full sweep, JSON lines
@@ -55,14 +58,19 @@ def score(network, batch_size, num_batches=10, dtype="bfloat16"):
     if dtype not in ("float32", "none", None):
         x = x.astype(dtype)
 
+    def sync(out):
+        # in-order device stream: fetching one element of the last output
+        # bounds every dispatch before it
+        return float(out.reshape((-1,))[0:1].asnumpy()[0])
+
     for _ in range(5):                     # warm-up (includes compile)
         out = net(x)
-    out.wait_to_read()
+    sync(out)
 
     t0 = time.perf_counter()
     for _ in range(num_batches):
         out = net(x)
-        out.wait_to_read()                 # per-batch sync, reference shape
+    sync(out)                              # host fetch = true sync
     dt = time.perf_counter() - t0
     return num_batches * batch_size / dt
 

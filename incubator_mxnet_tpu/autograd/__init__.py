@@ -132,6 +132,11 @@ def _run_backward(heads, head_grads, retain_graph, train_mode, variables=None,
     cotangent accumulation goes through the recorded add op — the
     returned gradients are ordinary tape-connected NDArrays.
     """
+    # any bulk-deferred segment must land its tape node before the walk
+    # (a recorded segment only becomes a node at flush)
+    from .. import engine as _engine
+    _engine.flush()
+
     s = _st()
     tape = list(s.tape)
     grads: dict[int, object] = {}

@@ -17,19 +17,30 @@ _read, in-place write, autograd capture).  Steady-state loops hit the
 replay cache, so N small ops cost one dispatch (measured ~5x on the
 eager micro-benchmark, bench_eager.py).
 
+Autograd-recording ops ARE deferrable (round 4 — the reference bulks
+*training* segments first and foremost, MXNET_EXEC_BULK_EXEC_TRAIN,
+threaded_engine.h:472-509): a segment containing recorded ops becomes
+ONE tape node at flush — the forward is the single jitted replay, and
+the backward is a single jitted vjp of the whole replay program, so an
+N-op recorded chain costs one dispatch forward and one backward instead
+of N + 2N.  Ops that ran under ``autograd.pause()`` inside the segment
+are wrapped in ``stop_gradient`` so the tape semantics match eager
+execution exactly.
+
 Out of scope for deferral (dispatched eagerly, exactly as before):
-autograd-recording ops (the tape takes jax.vjp at invoke), ``out=``
-stores, mutating ops (optimizer updates), sparse storage, ops that
-manage their own mesh placement (no_jit), and NaiveEngine mode.  VIEW
-creation (reshape/slice) over a deferred value materializes it — views
-share storage with their base, which must be concrete for write-through;
-keep chains view-free for maximal segments.
+``out=`` stores, mutating ops (optimizer updates), sparse storage, ops
+that manage their own mesh placement (no_jit), and NaiveEngine mode.
+VIEW creation (reshape/slice) over a deferred value materializes it —
+views share storage with their base, which must be concrete for
+write-through; keep chains view-free for maximal segments.
 """
 from __future__ import annotations
 
 import threading
+import weakref
 
 import jax
+import jax.numpy as jnp
 
 __all__ = ["bulk", "flush"]
 
@@ -72,17 +83,23 @@ class _BulkState(object):
         self.epoch = 0           # bumped per flush: "t" refs are only
         #                          valid within their own segment
         self.instructions = []   # (op_name, params, pkey, is_train,
-        #                           in_refs, rng_slot, n_out)
+        #                           in_refs, rng_slot, n_out, rec)
         self.ext = []            # concrete jax operands (program inputs)
         self.ext_ids = {}        # id(array) -> slot (identity dedup)
+        self.ext_owners = []     # weakref to the NDArray exposing a slot
         self.pendings = []       # _Pending objects in slot order
+        self.any_recorded = False
 
-    def add_ext(self, v):
+    def add_ext(self, v, owner=None):
         slot = self.ext_ids.get(id(v))
         if slot is None:
             self.ext.append(v)
+            self.ext_owners.append(weakref.ref(owner) if owner is not None
+                                   else None)
             slot = len(self.ext) - 1
             self.ext_ids[id(v)] = slot
+        elif owner is not None and self.ext_owners[slot] is None:
+            self.ext_owners[slot] = weakref.ref(owner)
         return slot
 
 
@@ -116,10 +133,12 @@ class bulk(object):
             _tls.state = self._prev
 
 
-def maybe_defer(op, params, vals, is_train, kw):
+def maybe_defer(op, params, vals, is_train, kw, rec=False, nd_inputs=None):
     """Called from the eager invoke: record the op if a bulk scope is
     active and every input is deferrable.  Returns a tuple of _Pending
-    outputs, or None to dispatch eagerly."""
+    outputs, or None to dispatch eagerly.  ``rec`` marks ops being taped
+    by autograd: the flush builds one tape node for the whole segment;
+    ``nd_inputs`` are the NDArray wrappers (gradient delivery targets)."""
     st = _current()
     if st is None:
         return None
@@ -134,13 +153,14 @@ def maybe_defer(op, params, vals, is_train, kw):
     # replay-cache key
     staged = []
     shapes = []
-    for v in vals:
+    for i, v in enumerate(vals):
         if type(v) is _Pending:
             if v.state is not st or v.epoch != st.epoch:
                 return None       # cross-scope/segment value: materialize
-            staged.append(("t", v))
+            staged.append(("t", v, None))
         else:
-            staged.append(("e", v))
+            owner = nd_inputs[i] if nd_inputs is not None else None
+            staged.append(("e", v, owner))
         shapes.append((tuple(v.shape), str(v.dtype)))
     pkey = _hashable(params)
     ikey = (op.name, tuple(shapes), pkey, bool(is_train))
@@ -151,8 +171,8 @@ def maybe_defer(op, params, vals, is_train, kw):
         except Exception:
             return None           # shape inference failed: run eagerly
         _infer_cache[ikey] = out_sig
-    in_refs = [(tag, v.slot if tag == "t" else st.add_ext(v))
-               for tag, v in staged]
+    in_refs = [(tag, v.slot if tag == "t" else st.add_ext(v, owner))
+               for tag, v, owner in staged]
     rng_slot = st.add_ext(kw["rng"]) if "rng" in kw else None
     outs = []
     for shp, dt in out_sig:
@@ -161,7 +181,8 @@ def maybe_defer(op, params, vals, is_train, kw):
         outs.append(p)
     st.instructions.append((op.name, dict(params), pkey,
                             bool(is_train), tuple(in_refs), rng_slot,
-                            len(outs)))
+                            len(outs), bool(rec)))
+    st.any_recorded |= bool(rec)
     return tuple(outs)
 
 
@@ -178,6 +199,98 @@ def resolve(pending):
     return pending.value
 
 
+def _build_replay(instrs, live):
+    """Pure replay fn over the ext operand list.  Ops taped by autograd
+    keep their gradients; ops that ran outside recording (pause scopes,
+    non-differentiable ops) are wrapped in stop_gradient so the segment's
+    single vjp matches eager tape semantics exactly."""
+    from .ops.registry import get_op
+    plan = [(get_op(name).raw(p, train), in_refs, rng_slot, n_out, rec)
+            for name, p, _k, train, in_refs, rng_slot, n_out, rec in instrs]
+
+    def replay(ext_vals):
+        tmp = []
+        for raw, in_refs, rng_slot, n_out, rec in plan:
+            args = [ext_vals[i] if tag == "e" else tmp[i]
+                    for tag, i in in_refs]
+            kw = {"rng": ext_vals[rng_slot]} if rng_slot is not None \
+                else {}
+            res = raw(*args, **kw)
+            if not isinstance(res, tuple):
+                res = (res,)
+            if not rec:
+                res = tuple(jax.lax.stop_gradient(r) for r in res)
+            tmp.extend(res)
+        return tuple(tmp[i] for i in live)
+
+    return replay
+
+
+def _record_segment_node(key, replay, ext, ext_owners, pendings, live):
+    """One tape node for the whole recorded segment: forward already ran
+    (the replay); backward is a single jitted vjp of the replay program
+    w.r.t. the float ext operands (the reference's train-segment bulking,
+    threaded_engine.h MXNET_EXEC_BULK_EXEC_TRAIN)."""
+    from . import autograd
+    from .operator import Operator
+
+    grad_slots = [i for i, v in enumerate(ext)
+                  if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)]
+    in_pairs = [(s, ext_owners[s]()) for s in grad_slots
+                if ext_owners[s] is not None and ext_owners[s]() is not None]
+    out_pairs = []          # (position in `live` results, owner NDArray)
+    for pos, i in enumerate(live):
+        p = pendings[i]
+        if not jnp.issubdtype(jnp.dtype(p.dtype), jnp.floating):
+            continue
+        owner = next((w() for w in p.owners if w() is not None), None)
+        if owner is not None:
+            out_pairs.append((pos, owner))
+    if not in_pairs or not out_pairs:
+        return
+    out_pos = tuple(pos for pos, _ in out_pairs)
+
+    vjp_key = (key, tuple(grad_slots), out_pos)
+    vjp_fn = _seg_vjp_cache.get(vjp_key)
+    if vjp_fn is None:
+        def vjp_calc(ext_vals, cts):
+            def f(fvals):
+                full = list(ext_vals)
+                for s, v in zip(grad_slots, fvals):
+                    full[s] = v
+                outs = replay(full)
+                return tuple(outs[pos] for pos in out_pos)
+            _, pullback = jax.vjp(f, tuple(ext_vals[s]
+                                           for s in grad_slots))
+            return pullback(tuple(cts))[0]
+        vjp_fn = jax.jit(vjp_calc)
+        _seg_vjp_cache[vjp_key] = vjp_fn
+
+    keep = {s: j for j, s in enumerate(grad_slots)}
+    in_slots = [s for s, _ in in_pairs]
+    nd_inputs = [nd for _, nd in in_pairs]
+    nd_outputs = [nd for _, nd in out_pairs]
+
+    def seg_vjp(ct):
+        cts = ct if isinstance(ct, tuple) else (ct,)
+        grads = vjp_fn(ext, tuple(cts))
+        return tuple(grads[keep[s]] for s in in_slots)
+
+    def seg_fn(*in_vals):
+        full = list(ext)
+        for s, v in zip(in_slots, in_vals):
+            full[s] = v
+        outs = replay(full)
+        picked = tuple(outs[pos] for pos in out_pos)
+        return picked[0] if len(picked) == 1 else picked
+
+    op = Operator("_BulkSegment", lambda *a: a,
+                  num_inputs=len(nd_inputs), num_outputs=len(nd_outputs))
+    # re-wrap outputs? no: the live NDArrays already exist — record against
+    # them so downstream recorded ops chain through this node
+    autograd._record(op, nd_inputs, nd_outputs, seg_vjp, fn=seg_fn)
+
+
 def flush(state=None):
     """Compile (cached) + run the pending segment; fill every _Pending."""
     st = state if state is not None else _current()
@@ -185,42 +298,31 @@ def flush(state=None):
         return
     instrs = st.instructions
     ext = st.ext
+    ext_owners = st.ext_owners
     pendings = st.pendings
+    recorded = st.any_recorded
     # reset the scope so new ops start a fresh segment (and so re-entrant
     # flushes from _read during execution see an empty program)
     st.instructions, st.ext, st.pendings = [], [], []
     st.ext_ids = {}
+    st.ext_owners = []
+    st.any_recorded = False
     st.epoch += 1
 
     # only values still exposed through a live NDArray leave the program
     live = tuple(i for i, p in enumerate(pendings)
                  if any(w() is not None for w in p.owners))
-    key = (tuple((name, pkey, train, in_refs, rng_slot, n_out)
-                 for name, _p, pkey, train, in_refs, rng_slot, n_out
+    key = (tuple((name, pkey, train, in_refs, rng_slot, n_out, rec)
+                 for name, _p, pkey, train, in_refs, rng_slot, n_out, rec
                  in instrs),
            tuple((tuple(v.shape), str(v.dtype)) for v in ext),
            live)
-    fn = _replay_cache.get(key)
-    if fn is None:
-        from .ops.registry import get_op
-        plan = [(get_op(name).raw(p, train), in_refs, rng_slot, n_out)
-                for name, p, _k, train, in_refs, rng_slot, n_out in instrs]
-
-        def replay(ext_vals):
-            tmp = []
-            for raw, in_refs, rng_slot, n_out in plan:
-                args = [ext_vals[i] if tag == "e" else tmp[i]
-                        for tag, i in in_refs]
-                kw = {"rng": ext_vals[rng_slot]} if rng_slot is not None \
-                    else {}
-                res = raw(*args, **kw)
-                if not isinstance(res, tuple):
-                    res = (res,)
-                tmp.extend(res)
-            return tuple(tmp[i] for i in live)
-
-        fn = jax.jit(replay)
-        _replay_cache[key] = fn
+    entry = _replay_cache.get(key)
+    if entry is None:
+        replay = _build_replay(instrs, live)
+        entry = (jax.jit(replay), replay)
+        _replay_cache[key] = entry
+    fn, replay = entry
     try:
         results = fn(ext)
     except Exception as exc:
@@ -231,6 +333,8 @@ def flush(state=None):
         raise
     for i, v in zip(live, results):
         pendings[i].value = v
+    if recorded:
+        _record_segment_node(key, replay, ext, ext_owners, pendings, live)
     if results:
         # nd.waitall()'s WaitForAll contract covers bulk dispatches too
         from .ndarray import ndarray as _nd
@@ -240,3 +344,6 @@ def flush(state=None):
                 _nd._DISPATCH_DEVICES.update(devs())
             except Exception:
                 pass
+
+
+_seg_vjp_cache = {}

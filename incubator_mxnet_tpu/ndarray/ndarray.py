@@ -570,11 +570,12 @@ def invoke(op: Operator, inputs, params, out=None):
         kw["rng"] = random_state.next_key()
 
     _eng = _engine_mod()
-    if (_eng._current() is not None and not recording and out is None
+    if (_eng._current() is not None and out is None
             and ctx_override is None and not op.mutate_inputs
             and not _NAIVE_ENGINE and not getattr(op, "no_jit", False)):
         vals = [a._read_deferred() for a in inputs]
-        pend = _eng.maybe_defer(op, params, vals, is_train, kw)
+        pend = _eng.maybe_defer(op, params, vals, is_train, kw,
+                                rec=recording, nd_inputs=inputs)
         if pend is not None:
             import weakref
             ctx = inputs[0]._ctx if inputs else current_context()

@@ -140,19 +140,21 @@ class DistKVStore(KVStore):
         self._ps_server = None
         self._ps = None
         if type_ == "dist_async":
+            import os
             from . import ps
             idx = _ps_counter[0]
             _ps_counter[0] += 1
-            key = "%s/%d" % (ps._ADDR_KEY, idx)
+            n_srv = int(os.environ.get("MXTPU_PS_NUM_SERVERS", "1"))
             if num_workers() <= 1:
-                self._ps_server = ps.ParameterServer()
-                self._ps = ps.PSClient(self._ps_server.address)
+                self._ps_server = ps.ServerGroup(n_srv)
+                self._ps = ps.GroupClient(self._ps_server.address, rank=0)
             elif rank() == 0:
-                self._ps_server = ps.ParameterServer()
+                self._ps_server = ps.ServerGroup(n_srv)
                 ps.publish_address(self._ps_server.address, idx)
-                self._ps = ps.PSClient(self._ps_server.address)
+                self._ps = ps.GroupClient(self._ps_server.address, rank=0)
             else:
-                self._ps = ps.PSClient(ps.lookup_address(idx))
+                self._ps = ps.GroupClient(ps.lookup_address(idx),
+                                          rank=rank())
 
     # -- dist_async: the host parameter service -----------------------------
     def _async_np(self, nd_value):
@@ -213,17 +215,43 @@ class DistKVStore(KVStore):
         if self._ps is None:
             return super().row_sparse_pull(key, out=out, priority=priority,
                                            row_ids=row_ids)
-        # refresh the local mirror from the server FIRST: the base
-        # implementation row-selects from self._store, which otherwise
-        # holds init-time values forever on the async path
         import jax.numpy as _jnp
         keys, _ = self._normalize(key, out)
-        fetched = self._ps.pull([str(k) for k in keys])
-        for k in keys:
-            self._store[k]._write(_jnp.asarray(fetched[str(k)]).astype(
-                self._store[k].dtype))
+        if row_ids is not None:
+            # ship ONLY the requested rows (kvstore_dist_server.h:223):
+            # scatter them into the local mirror, then let the base
+            # implementation row-select from it
+            id_list = row_ids if isinstance(row_ids, (list, tuple)) \
+                else [row_ids] * len(keys)
+            for k, ids_nd in zip(keys, id_list):
+                ids = np.asarray(ids_nd._read()
+                                 if hasattr(ids_nd, "_read")
+                                 else ids_nd).astype(np.int64).ravel()
+                rows = self._ps.pull_rows({str(k): ids})[str(k)]
+                if len(ids):
+                    # scatter ON DEVICE: no full-table host round-trip
+                    cur = self._store[k]._read()
+                    self._store[k]._write(cur.at[_jnp.asarray(ids)].set(
+                        _jnp.asarray(rows, cur.dtype)))
+        else:
+            # full refresh: the mirror otherwise holds init-time values
+            # forever on the async path
+            fetched = self._ps.pull([str(k) for k in keys])
+            for k in keys:
+                self._store[k]._write(_jnp.asarray(fetched[str(k)]).astype(
+                    self._store[k].dtype))
         return super().row_sparse_pull(key, out=out, priority=priority,
                                        row_ids=row_ids)
+
+    def num_dead_nodes(self, node_id=0, timeout_sec=5):
+        """Workers whose heartbeats stopped (ref: MXKVStoreGetNumDeadNode,
+        kvstore_dist.h:109-115).  Only the async parameter service keeps
+        heartbeats; on the sync wire the jax coordination service
+        terminates the job on member failure, so a live process always
+        observes 0."""
+        if self._ps is None:
+            return 0
+        return len(self._ps.dead_nodes(window=float(timeout_sec)))
 
     def _sync_init(self, key, value):
         """Rank 0's value defines the key globally (ref: kvstore_dist.h

@@ -19,10 +19,20 @@ reference does, the asynchronous state lives on a HOST service:
   service's key-value store (the Postoffice/scheduler's successor), so
   launch topology stays tools/launch.py with zero extra flags.
 
-This is a prototype-grade transport (one TCP connection per worker,
-pickled frames) standing in for ps-lite's ZMQ — the semantics
-(immediate-apply, server-side updater, update_on_kvstore) are the
-reference's, the wire is deliberately simple.
+Scale-out shape (round 4): ``ServerGroup`` runs N server threads and
+``GroupClient`` shards keys across them; arrays bigger than
+``MXTPU_KVSTORE_BIGARRAY_BOUND`` (default 1M elements) are row-sliced
+across ALL servers — the reference's big-array sharding
+(kvstore_dist.h MXNET_KVSTORE_BIGARRAY_BOUND).  Clients heartbeat the
+group; ``dead_nodes()`` reports workers whose beats stopped
+(kvstore_dist.h:109-115 num_dead_nodes).  ``pull_rows`` ships ONLY the
+requested rows (kvstore_dist_server.h:223 row_sparse handling).
+
+SECURITY: the wire is UNAUTHENTICATED pickled TCP — deserializing a
+pickle executes arbitrary code, so anyone who can reach the port owns
+the process.  Bind only on trusted/isolated networks (the same trust
+model ps-lite's plain ZMQ wire assumes); this transport is a
+prototype-grade stand-in, not a hardened service.
 """
 from __future__ import annotations
 
@@ -30,13 +40,20 @@ import pickle
 import socket
 import struct
 import threading
+import time
+import zlib
 
 import numpy as np
 
-__all__ = ["ParameterServer", "PSClient", "publish_address",
-           "lookup_address"]
+__all__ = ["ParameterServer", "PSClient", "ServerGroup", "GroupClient",
+           "publish_address", "lookup_address", "BIGARRAY_BOUND"]
 
 _LEN = struct.Struct("<Q")
+
+
+def BIGARRAY_BOUND():
+    import os
+    return int(os.environ.get("MXTPU_KVSTORE_BIGARRAY_BOUND", str(1 << 20)))
 
 
 def _advertised_host():
@@ -78,6 +95,7 @@ class ParameterServer(object):
     def __init__(self, host="0.0.0.0", port=0):
         self._store = {}          # key -> np.ndarray (authoritative)
         self._updater = None      # (key:int, grad, weight) -> None, in place
+        self._beats = {}          # worker rank -> last heartbeat time
         self._lock = threading.Lock()
         self._srv = socket.create_server((host, port))
         # advertise a ROUTABLE address (multi-host workers must reach it;
@@ -152,6 +170,24 @@ class ParameterServer(object):
             with self._lock:
                 out = {k: self._store[k].copy() for k in msg["keys"]}
             _send_msg(conn, {"ok": True, "kv": out})
+        elif cmd == "pull_rows":
+            # ship ONLY the requested rows (kvstore_dist_server.h:223) —
+            # the async row_sparse_pull path must not move whole matrices
+            with self._lock:
+                rows = {k: self._store[k][np.asarray(ids, np.int64)]
+                        for k, ids in msg["kv"].items()}
+            _send_msg(conn, {"ok": True, "kv": rows})
+        elif cmd == "heartbeat":
+            with self._lock:
+                self._beats[msg["rank"]] = time.monotonic()
+            _send_msg(conn, {"ok": True})
+        elif cmd == "dead_nodes":
+            window = float(msg.get("window", 5.0))
+            now = time.monotonic()
+            with self._lock:
+                dead = [r for r, t in self._beats.items()
+                        if now - t > window]
+            _send_msg(conn, {"ok": True, "dead": sorted(dead)})
         elif cmd == "set_optimizer":
             # the reference pickles the optimizer to servers
             # (kvstore.py _send_command_to_servers / kController).
@@ -213,15 +249,186 @@ class PSClient(object):
     def pull(self, keys):
         return self._call({"cmd": "pull", "keys": list(keys)})["kv"]
 
+    def pull_rows(self, kv):
+        """{key: row_ids} -> {key: rows} — only the requested rows move."""
+        return self._call({"cmd": "pull_rows", "kv": kv})["kv"]
+
     def set_optimizer(self, optimizer):
         self._call({"cmd": "set_optimizer",
                     "optimizer": pickle.dumps(optimizer)})
+
+    def heartbeat(self, rank):
+        self._call({"cmd": "heartbeat", "rank": int(rank)})
+
+    def dead_nodes(self, window=5.0):
+        return self._call({"cmd": "dead_nodes", "window": window})["dead"]
 
     def close(self):
         try:
             self._sock.close()
         except OSError:
             pass
+
+
+class ServerGroup(object):
+    """N server threads in one process — the server-group role of ps-lite.
+    Keys hash-shard across members; big arrays row-slice across ALL of
+    them (GroupClient does the placement)."""
+
+    def __init__(self, num_servers=1):
+        self.servers = [ParameterServer() for _ in range(max(1, num_servers))]
+        self.address = ",".join(s.address for s in self.servers)
+
+    def shutdown(self):
+        for s in self.servers:
+            s.shutdown()
+
+
+class GroupClient(object):
+    """One worker's connections to a ServerGroup.
+
+    Placement: key k lives on server ``crc32(k) % N`` unless its value
+    exceeds BIGARRAY_BOUND elements, in which case its rows are sliced
+    into N contiguous blocks, block i on server i under subkey ``k@i``
+    (the reference's MXNET_KVSTORE_BIGARRAY_BOUND sharding).  A
+    background thread heartbeats every server so the group can report
+    dead workers.
+    """
+
+    def __init__(self, address, rank=None):
+        self._clients = [PSClient(a) for a in address.split(",")]
+        self._n = len(self._clients)
+        self._big = {}            # key -> row-block boundaries (list)
+        self._rank = rank
+        self._hb_stop = threading.Event()
+        if rank is not None:
+            t = threading.Thread(target=self._beat_loop, daemon=True)
+            t.start()
+
+    # -- placement ---------------------------------------------------------
+    def _shard_of(self, key):
+        return zlib.crc32(str(key).encode()) % self._n
+
+    def _blocks(self, key, nrows):
+        cuts = np.linspace(0, nrows, self._n + 1).astype(int)
+        self._big[key] = cuts
+        return cuts
+
+    def _is_big(self, v):
+        return self._n > 1 and v.ndim >= 1 and v.size > BIGARRAY_BOUND()
+
+    def _beat_loop(self):
+        # first beat IMMEDIATELY: membership must register before a fast
+        # exit, or a worker that dies young is never counted dead
+        while True:
+            alive = 0
+            for c in self._clients:
+                # per-server failure isolation: one broken connection must
+                # not silence heartbeats to the healthy members (which
+                # would count this live worker dead)
+                try:
+                    c.heartbeat(self._rank)
+                    alive += 1
+                except Exception:
+                    continue
+            if alive == 0:
+                return            # whole group gone: nothing to report to
+            if self._hb_stop.wait(1.0):
+                return
+
+    # -- api (same surface as PSClient) ------------------------------------
+    def init(self, kv):
+        per = [dict() for _ in range(self._n)]
+        for k, v in kv.items():
+            v = np.asarray(v)
+            if self._is_big(v):
+                cuts = self._blocks(k, v.shape[0])
+                for i in range(self._n):
+                    per[i]["%s@%d" % (k, i)] = v[cuts[i]:cuts[i + 1]]
+            else:
+                per[self._shard_of(k)][k] = v
+        for c, kvs in zip(self._clients, per):
+            if kvs:
+                c.init(kvs)
+
+    def push(self, kv):
+        per = [dict() for _ in range(self._n)]
+        for k, v in kv.items():
+            v = np.asarray(v)
+            if k in self._big or self._is_big(v):
+                cuts = self._big.get(k)
+                if cuts is None:
+                    cuts = self._blocks(k, v.shape[0])
+                for i in range(self._n):
+                    per[i]["%s@%d" % (k, i)] = v[cuts[i]:cuts[i + 1]]
+            else:
+                per[self._shard_of(k)][k] = v
+        for c, kvs in zip(self._clients, per):
+            if kvs:
+                c.push(kvs)
+
+    def pull(self, keys):
+        per = [list() for _ in range(self._n)]
+        for k in keys:
+            if k in self._big:
+                for i in range(self._n):
+                    per[i].append("%s@%d" % (k, i))
+            else:
+                per[self._shard_of(k)].append(k)
+        got = {}
+        for c, ks in zip(self._clients, per):
+            if ks:
+                got.update(c.pull(ks))
+        out = {}
+        for k in keys:
+            if k in self._big:
+                out[k] = np.concatenate(
+                    [got["%s@%d" % (k, i)] for i in range(self._n)], axis=0)
+            else:
+                out[k] = got[k]
+        return out
+
+    def pull_rows(self, kv):
+        """{key: row_ids} -> {key: rows}: only requested rows cross the
+        wire, routed to the owning row-block for sharded arrays."""
+        out = {}
+        for k, ids in kv.items():
+            ids = np.asarray(ids, np.int64)
+            if ids.size == 0:
+                probe = self.pull([k])[k]
+                out[k] = np.empty((0,) + probe.shape[1:], probe.dtype)
+            elif k in self._big:
+                cuts = self._big[k]
+                parts = np.empty((len(ids),), object)
+                for i in range(self._n):
+                    sel = (ids >= cuts[i]) & (ids < cuts[i + 1])
+                    if not sel.any():
+                        continue
+                    rows = self._clients[i].pull_rows(
+                        {"%s@%d" % (k, i): ids[sel] - cuts[i]})
+                    vals = rows["%s@%d" % (k, i)]
+                    for j, pos in enumerate(np.nonzero(sel)[0]):
+                        parts[pos] = vals[j]
+                out[k] = np.stack(list(parts))
+            else:
+                out[k] = self._clients[self._shard_of(k)].pull_rows(
+                    {k: ids})[k]
+        return out
+
+    def set_optimizer(self, optimizer):
+        for c in self._clients:
+            c.set_optimizer(optimizer)
+
+    def dead_nodes(self, window=5.0):
+        dead = set()
+        for c in self._clients:
+            dead.update(c.dead_nodes(window))
+        return sorted(dead)
+
+    def close(self):
+        self._hb_stop.set()
+        for c in self._clients:
+            c.close()
 
 
 # -- address rendezvous through the jax coordination service ---------------

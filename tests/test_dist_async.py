@@ -89,3 +89,71 @@ def test_async_module_fit():
             initializer=mx.init.Xavier(magnitude=2.0))
     score = dict(mod.score(data, "acc"))
     assert score["accuracy"] > 0.9, score
+
+
+def test_server_group_shards_keys_and_big_arrays():
+    """N-server group: small keys hash-shard, big arrays row-slice across
+    ALL servers (kvstore_dist.h MXNET_KVSTORE_BIGARRAY_BOUND), and the
+    client reassembles exactly."""
+    import os
+    from incubator_mxnet_tpu.parallel import ps
+
+    os.environ["MXTPU_KVSTORE_BIGARRAY_BOUND"] = "1000"
+    try:
+        grp = ps.ServerGroup(3)
+        cli = ps.GroupClient(grp.address, rank=0)
+        rs = np.random.RandomState(0)
+        small = {"a": rs.randn(10).astype(np.float32),
+                 "b": rs.randn(7, 3).astype(np.float32)}
+        big = rs.randn(600, 4).astype(np.float32)   # 2400 > bound
+        cli.init({**small, "big": big})
+
+        # big array must be row-sliced across every member server
+        sub_counts = [sum(1 for k in s._store if k.startswith("big@"))
+                      for s in grp.servers]
+        assert sub_counts == [1, 1, 1], sub_counts
+
+        got = cli.pull(["a", "b", "big"])
+        np.testing.assert_array_equal(got["a"], small["a"])
+        np.testing.assert_array_equal(got["b"], small["b"])
+        np.testing.assert_array_equal(got["big"], big)
+
+        # push accumulates through the shards
+        cli.push({"big": np.ones_like(big)})
+        np.testing.assert_allclose(cli.pull(["big"])["big"], big + 1.0)
+
+        # pull_rows ships only requested rows, across block boundaries
+        ids = np.array([0, 199, 200, 599], np.int64)
+        rows = cli.pull_rows({"big": ids})["big"]
+        np.testing.assert_allclose(rows, (big + 1.0)[ids])
+
+        # heartbeat -> dead_nodes: rank 0 beat recently (alive); a rank
+        # that beat once and went silent is dead past the window
+        cli2 = ps.GroupClient(grp.address, rank=7)
+        import time as _t
+        _t.sleep(1.5)                     # let both heartbeat loops beat
+        assert cli2.dead_nodes(window=60.0) == []
+        cli2._hb_stop.set()               # rank 7 "dies"
+        _t.sleep(0.5)
+        assert 7 in cli.dead_nodes(window=0.4)
+        cli.close()
+        cli2.close()
+        grp.shutdown()
+    finally:
+        del os.environ["MXTPU_KVSTORE_BIGARRAY_BOUND"]
+
+
+def test_async_row_sparse_pull_row_ids():
+    """row_sparse_pull with row_ids on the async path fetches ONLY the
+    requested rows from the service (kvstore_dist_server.h:223)."""
+    kv = mx.kv.create("dist_async")
+    w = np.arange(24, dtype=np.float32).reshape(6, 4)
+    kv.init("rs", nd.array(w))
+    kv.push("rs", nd.array(np.ones_like(w)))
+    out = nd.zeros((6, 4)).tostype("row_sparse")
+    ids = nd.array(np.array([1, 4], np.float32))
+    kv.row_sparse_pull("rs", out=out, row_ids=ids)
+    dense = out.todense().asnumpy()
+    np.testing.assert_allclose(dense[1], w[1] + 1)
+    np.testing.assert_allclose(dense[4], w[4] + 1)
+    assert kv.num_dead_nodes() == 0
