@@ -32,7 +32,7 @@ import jax.numpy as jnp                          # noqa: E402
 
 from incubator_mxnet_tpu.ops.registry import get_op  # noqa: E402
 
-REPEATS = 20
+REPEATS = int(os.environ.get("BENCH_REPEATS", "20"))
 
 # reference sweep (benchmark_op.py:73-89): resnet-style conv shapes
 CONV_CONFIGS = [

@@ -1,0 +1,118 @@
+// C++ training surface over the MXTrainer* C ABI — the TPU rebuild's
+// cpp-package (ref: cpp-package/include/mxnet-cpp/, which wraps the
+// reference's C API the same way for C++ training without Python).
+//
+// Header-only RAII wrapper; link against src/build/libmxtpu_train.so.
+//
+//   mxtpu::Trainer t(symbol_json, {{"data", {64, 6}},
+//                                  {"softmax_label", {64}}},
+//                    "sgd", R"({"learning_rate": 0.5})");
+//   t.SetInput("data", x.data(), x.size());
+//   t.SetInput("softmax_label", y.data(), y.size());
+//   float loss = t.Step();            // forward + backward + update
+//   std::string params = t.SaveParams();
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+extern "C" {
+int MXTrainerCreate(const char*, const char*, const char*, const void*, int,
+                    uint32_t, const char**, const uint32_t*, const uint32_t*,
+                    void**);
+int MXTrainerSetInput(void*, const char*, const float*, uint32_t);
+int MXTrainerStep(void*, float*);
+int MXTrainerForward(void*);
+int MXTrainerGetOutputShape(void*, uint32_t, uint32_t**, uint32_t*);
+int MXTrainerGetOutput(void*, uint32_t, float*, uint32_t);
+int MXTrainerSaveParams(void*, const char**, uint64_t*);
+int MXTrainerFree(void*);
+const char* MXTrainGetLastError();
+}
+
+namespace mxtpu {
+
+class Trainer {
+ public:
+  Trainer(const std::string& symbol_json,
+          const std::map<std::string, std::vector<uint32_t>>& input_shapes,
+          const std::string& optimizer = "sgd",
+          const std::string& optimizer_params_json = "",
+          const std::string& param_bytes = "") {
+    std::vector<const char*> keys;
+    std::vector<uint32_t> indptr{0};
+    std::vector<uint32_t> dims;
+    for (const auto& kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      for (uint32_t d : kv.second) dims.push_back(d);
+      indptr.push_back(static_cast<uint32_t>(dims.size()));
+    }
+    if (MXTrainerCreate(symbol_json.c_str(), optimizer.c_str(),
+                        optimizer_params_json.empty()
+                            ? nullptr
+                            : optimizer_params_json.c_str(),
+                        param_bytes.empty() ? nullptr : param_bytes.data(),
+                        static_cast<int>(param_bytes.size()),
+                        static_cast<uint32_t>(keys.size()), keys.data(),
+                        indptr.data(), dims.data(), &handle_) != 0) {
+      throw std::runtime_error(MXTrainGetLastError());
+    }
+  }
+
+  ~Trainer() {
+    if (handle_) MXTrainerFree(handle_);
+  }
+  Trainer(const Trainer&) = delete;
+  Trainer& operator=(const Trainer&) = delete;
+
+  void SetInput(const std::string& key, const float* data, size_t size) {
+    Check(MXTrainerSetInput(handle_, key.c_str(), data,
+                            static_cast<uint32_t>(size)));
+  }
+
+  // One fused train step on the staged inputs; returns the batch loss.
+  float Step() {
+    float loss = 0.f;
+    Check(MXTrainerStep(handle_, &loss));
+    return loss;
+  }
+
+  void Forward() { Check(MXTrainerForward(handle_)); }
+
+  std::vector<uint32_t> OutputShape(uint32_t index = 0) {
+    uint32_t* data = nullptr;
+    uint32_t ndim = 0;
+    Check(MXTrainerGetOutputShape(handle_, index, &data, &ndim));
+    return std::vector<uint32_t>(data, data + ndim);
+  }
+
+  std::vector<float> GetOutput(uint32_t index = 0) {
+    auto shape = OutputShape(index);
+    size_t n = 1;
+    for (uint32_t d : shape) n *= d;
+    std::vector<float> out(n);
+    Check(MXTrainerGetOutput(handle_, index, out.data(),
+                             static_cast<uint32_t>(n)));
+    return out;
+  }
+
+  // MXNet-binary .params bytes of the current parameters (loadable by
+  // Python nd.load / Module and by MXPredCreate).
+  std::string SaveParams() {
+    const char* bytes = nullptr;
+    uint64_t size = 0;
+    Check(MXTrainerSaveParams(handle_, &bytes, &size));
+    return std::string(bytes, static_cast<size_t>(size));
+  }
+
+ private:
+  static void Check(int rc) {
+    if (rc != 0) throw std::runtime_error(MXTrainGetLastError());
+  }
+  void* handle_ = nullptr;
+};
+
+}  // namespace mxtpu

@@ -85,21 +85,31 @@ class _BulkState(object):
         self.instructions = []   # (op_name, params, pkey, is_train,
         #                           in_refs, rng_slot, n_out, rec)
         self.ext = []            # concrete jax operands (program inputs)
-        self.ext_ids = {}        # id(array) -> slot (identity dedup)
+        self.ext_ids = {}        # id(owner NDArray)|id(value) -> slot
         self.ext_owners = []     # weakref to the NDArray exposing a slot
+        self.ext_pins = []       # strong refs pinning owner ids for the
+        #                          segment (id() recycling would corrupt
+        #                          the dedup table otherwise)
         self.pendings = []       # _Pending objects in slot order
         self.any_recorded = False
 
     def add_ext(self, v, owner=None):
-        slot = self.ext_ids.get(id(v))
+        # dedup by (owner NDArray, buffer): two distinct NDArrays can
+        # share a buffer (x and x.detach()) but must keep separate
+        # gradient slots, and one NDArray can RE-BIND its buffer
+        # mid-segment (an in-place write between deferred ops) and must
+        # then get a fresh slot — keying on either identity alone loses
+        # one of the two cases.  Owners are pinned in ext_pins so ids
+        # cannot be recycled mid-segment (values are pinned via ext).
+        key = (id(owner) if owner is not None else None, id(v))
+        slot = self.ext_ids.get(key)
         if slot is None:
             self.ext.append(v)
             self.ext_owners.append(weakref.ref(owner) if owner is not None
                                    else None)
+            self.ext_pins.append(owner)
             slot = len(self.ext) - 1
-            self.ext_ids[id(v)] = slot
-        elif owner is not None and self.ext_owners[slot] is None:
-            self.ext_owners[slot] = weakref.ref(owner)
+            self.ext_ids[key] = slot
         return slot
 
 
@@ -226,7 +236,30 @@ def _build_replay(instrs, live):
     return replay
 
 
-def _record_segment_node(key, replay, ext, ext_owners, pendings, live):
+def _rec_reachable_ext(instrs):
+    """Ext slots whose gradient path reaches a recorded instruction
+    through recorded-op chains only (stop_gradient blocks every other
+    path, so those slots are the exact tape-input set)."""
+    ext_slots = set()
+    pend_deps = []
+    for _name, _p, _k, _train, in_refs, _rng, n_out, rec in instrs:
+        if rec:
+            deps = set()
+            for tag, i in in_refs:
+                if tag == "e":
+                    deps.add(i)
+                else:
+                    deps |= pend_deps[i]
+            ext_slots |= deps
+            out_deps = frozenset(deps)
+        else:
+            out_deps = frozenset()
+        pend_deps.extend([out_deps] * n_out)
+    return ext_slots
+
+
+def _record_segment_node(key, replay, ext, ext_owners, pendings, live,
+                         instrs):
     """One tape node for the whole recorded segment: forward already ran
     (the replay); backward is a single jitted vjp of the replay program
     w.r.t. the float ext operands (the reference's train-segment bulking,
@@ -236,8 +269,15 @@ def _record_segment_node(key, replay, ext, ext_owners, pendings, live):
 
     grad_slots = [i for i, v in enumerate(ext)
                   if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)]
+    # Only ext operands that can actually RECEIVE gradient belong on the
+    # tape node: slots feeding recorded instructions, directly or through
+    # chains of recorded ops (non-recorded outputs are stop_gradient'd,
+    # so paths through them are dead — and eager semantics would not put
+    # those inputs on the tape at all).
+    reachable = _rec_reachable_ext(instrs)
     in_pairs = [(s, ext_owners[s]()) for s in grad_slots
-                if ext_owners[s] is not None and ext_owners[s]() is not None]
+                if s in reachable
+                and ext_owners[s] is not None and ext_owners[s]() is not None]
     out_pairs = []          # (position in `live` results, owner NDArray)
     for pos, i in enumerate(live):
         p = pendings[i]
@@ -306,6 +346,7 @@ def flush(state=None):
     st.instructions, st.ext, st.pendings = [], [], []
     st.ext_ids = {}
     st.ext_owners = []
+    st.ext_pins = []
     st.any_recorded = False
     st.epoch += 1
 
@@ -334,7 +375,8 @@ def flush(state=None):
     for i, v in zip(live, results):
         pendings[i].value = v
     if recorded:
-        _record_segment_node(key, replay, ext, ext_owners, pendings, live)
+        _record_segment_node(key, replay, ext, ext_owners, pendings, live,
+                             instrs)
     if results:
         # nd.waitall()'s WaitForAll contract covers bulk dispatches too
         from .ndarray import ndarray as _nd

@@ -140,7 +140,6 @@ class DistKVStore(KVStore):
         self._ps_server = None
         self._ps = None
         if type_ == "dist_async":
-            import os
             from . import ps
             idx = _ps_counter[0]
             _ps_counter[0] += 1
@@ -227,12 +226,13 @@ class DistKVStore(KVStore):
                 ids = np.asarray(ids_nd._read()
                                  if hasattr(ids_nd, "_read")
                                  else ids_nd).astype(np.int64).ravel()
+                if not len(ids):
+                    continue        # nothing requested: no wire traffic
                 rows = self._ps.pull_rows({str(k): ids})[str(k)]
-                if len(ids):
-                    # scatter ON DEVICE: no full-table host round-trip
-                    cur = self._store[k]._read()
-                    self._store[k]._write(cur.at[_jnp.asarray(ids)].set(
-                        _jnp.asarray(rows, cur.dtype)))
+                # scatter ON DEVICE: no full-table host round-trip
+                cur = self._store[k]._read()
+                self._store[k]._write(cur.at[_jnp.asarray(ids)].set(
+                    _jnp.asarray(rows, cur.dtype)))
         else:
             # full refresh: the mirror otherwise holds init-time values
             # forever on the async path

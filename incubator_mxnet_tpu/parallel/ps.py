@@ -177,6 +177,14 @@ class ParameterServer(object):
                 rows = {k: self._store[k][np.asarray(ids, np.int64)]
                         for k, ids in msg["kv"].items()}
             _send_msg(conn, {"ok": True, "kv": rows})
+        elif cmd == "stat":
+            # shape/dtype metadata for the keys this server holds (used
+            # by late-joining clients to discover big-array placement)
+            with self._lock:
+                meta = {k: (tuple(self._store[k].shape),
+                            str(self._store[k].dtype))
+                        for k in msg["keys"] if k in self._store}
+            _send_msg(conn, {"ok": True, "meta": meta})
         elif cmd == "heartbeat":
             with self._lock:
                 self._beats[msg["rank"]] = time.monotonic()
@@ -253,6 +261,10 @@ class PSClient(object):
         """{key: row_ids} -> {key: rows} — only the requested rows move."""
         return self._call({"cmd": "pull_rows", "kv": kv})["kv"]
 
+    def stat(self, keys):
+        """{key: (shape, dtype)} for the keys this server holds."""
+        return self._call({"cmd": "stat", "keys": list(keys)})["meta"]
+
     def set_optimizer(self, optimizer):
         self._call({"cmd": "set_optimizer",
                     "optimizer": pickle.dumps(optimizer)})
@@ -299,6 +311,7 @@ class GroupClient(object):
         self._clients = [PSClient(a) for a in address.split(",")]
         self._n = len(self._clients)
         self._big = {}            # key -> row-block boundaries (list)
+        self._small = set()       # keys known to live whole on one shard
         self._rank = rank
         self._hb_stop = threading.Event()
         if rank is not None:
@@ -316,6 +329,31 @@ class GroupClient(object):
 
     def _is_big(self, v):
         return self._n > 1 and v.ndim >= 1 and v.size > BIGARRAY_BOUND()
+
+    def _discover(self, key):
+        """Resolve placement for a key this client never init/pushed (a
+        late-joining or restarted worker): ask the hash shard first, then
+        probe every server for the key's row blocks and rebuild the cut
+        table from the block shapes.  Results cache both ways, so the
+        hot pull path pays the stat round-trip once per key."""
+        if key in self._big:
+            return True
+        if key in self._small:
+            return False
+        if self._clients[self._shard_of(key)].stat([key]).get(key):
+            self._small.add(key)
+            return False            # whole key on its hash shard: small
+        nrows = [0] * self._n
+        found = False
+        for i, c in enumerate(self._clients):
+            meta = c.stat(["%s@%d" % (key, i)]).get("%s@%d" % (key, i))
+            if meta:
+                nrows[i] = meta[0][0]
+                found = True
+        if not found:
+            raise KeyError("parameter %r unknown to the server group" % key)
+        self._big[key] = np.concatenate([[0], np.cumsum(nrows)])
+        return True
 
     def _beat_loop(self):
         # first beat IMMEDIATELY: membership must register before a fast
@@ -346,6 +384,7 @@ class GroupClient(object):
                 for i in range(self._n):
                     per[i]["%s@%d" % (k, i)] = v[cuts[i]:cuts[i + 1]]
             else:
+                self._small.add(k)
                 per[self._shard_of(k)][k] = v
         for c, kvs in zip(self._clients, per):
             if kvs:
@@ -355,19 +394,23 @@ class GroupClient(object):
         per = [dict() for _ in range(self._n)]
         for k, v in kv.items():
             v = np.asarray(v)
-            if k in self._big or self._is_big(v):
+            if k in self._big or (k not in self._small and self._is_big(v)):
                 cuts = self._big.get(k)
                 if cuts is None:
                     cuts = self._blocks(k, v.shape[0])
                 for i in range(self._n):
                     per[i]["%s@%d" % (k, i)] = v[cuts[i]:cuts[i + 1]]
             else:
+                self._small.add(k)
                 per[self._shard_of(k)][k] = v
         for c, kvs in zip(self._clients, per):
             if kvs:
                 c.push(kvs)
 
     def pull(self, keys):
+        if self._n > 1:
+            for k in keys:
+                self._discover(k)
         per = [list() for _ in range(self._n)]
         for k in keys:
             if k in self._big:
@@ -394,9 +437,16 @@ class GroupClient(object):
         out = {}
         for k, ids in kv.items():
             ids = np.asarray(ids, np.int64)
+            if self._n > 1:
+                self._discover(k)
             if ids.size == 0:
-                probe = self.pull([k])[k]
-                out[k] = np.empty((0,) + probe.shape[1:], probe.dtype)
+                # metadata only — never move the table for an empty pull
+                if k in self._big:
+                    meta = self._clients[0].stat([k + "@0"])[k + "@0"]
+                else:
+                    meta = self._clients[self._shard_of(k)].stat([k])[k]
+                out[k] = np.empty((0,) + tuple(meta[0][1:]),
+                                  np.dtype(meta[1]))
             elif k in self._big:
                 cuts = self._big[k]
                 parts = np.empty((len(ids),), object)

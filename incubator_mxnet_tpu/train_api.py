@@ -1,0 +1,145 @@
+"""Python side of the C train API (src/train/c_train_api.cc).
+
+The native MXTrainer* functions embed an interpreter and drive this
+module — the TPU rebuild's answer to the reference's C++ training
+surface (ref: cpp-package/include/mxnet-cpp/: Symbol/Executor/Optimizer
+driven from C++; all of the reference's non-Python bindings sit on one C
+ABI, SURVEY §1 layer 10).  ``create_trainer`` binds a Module for
+training; each ``step`` is forward + backward + optimizer update on the
+currently set inputs, returning the batch loss.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import tempfile
+
+import numpy as np
+
+__all__ = ["CTrainer", "create_trainer"]
+
+
+class CTrainer(object):
+    """One bound training graph driven through the C ABI."""
+
+    def __init__(self, symbol_json, input_shapes, optimizer="sgd",
+                 optimizer_params=None, param_bytes=None):
+        from . import symbol as sym_mod
+        from . import module as mod_mod
+        from . import initializer
+        from .context import cpu
+
+        self._sym = sym_mod.load_json(symbol_json)
+        shapes = {k: tuple(int(d) for d in v)
+                  for k, v in input_shapes.items()}
+        label_names = [k for k in shapes if k.endswith("label")]
+        data_names = [k for k in shapes if k not in label_names]
+        self._mod = mod_mod.Module(self._sym, data_names=data_names,
+                                   label_names=label_names or None,
+                                   context=cpu())
+        self._mod.bind(
+            data_shapes=[(k, shapes[k]) for k in data_names],
+            label_shapes=[(k, shapes[k]) for k in label_names] or None,
+            for_training=True)
+        if param_bytes:
+            arg_params, aux_params = self._load_params(param_bytes)
+            self._mod.init_params(initializer.Xavier(), arg_params=arg_params,
+                                  aux_params=aux_params,
+                                  allow_missing=True)
+        else:
+            self._mod.init_params(initializer.Xavier(magnitude=2.0))
+        self._mod.init_optimizer(
+            optimizer=optimizer,
+            optimizer_params=json.loads(optimizer_params)
+            if isinstance(optimizer_params, str) else (optimizer_params or
+                                                       {"learning_rate": 0.01}))
+        self._inputs = {}
+        self._data_names = data_names
+        self._label_names = label_names
+        self._shapes = shapes
+
+    @staticmethod
+    def _load_params(param_bytes):
+        from . import ndarray as nd
+        with tempfile.NamedTemporaryFile(delete=False) as f:
+            f.write(param_bytes)
+            path = f.name
+        try:
+            loaded = nd.load(path)
+        finally:
+            os.unlink(path)
+        arg_params, aux_params = {}, {}
+        for k, v in loaded.items():
+            if k.startswith("aux:"):
+                aux_params[k[4:]] = v
+            else:
+                arg_params[k.split(":", 1)[-1]] = v
+        return arg_params, aux_params
+
+    # -- C ABI surface -----------------------------------------------------
+    def set_input(self, key, data_bytes):
+        shape = self._shapes[key]
+        arr = np.frombuffer(data_bytes, np.float32).reshape(shape)
+        self._inputs[key] = arr.copy()
+
+    def step(self):
+        """forward + backward + update on the staged inputs; returns the
+        mean cross-entropy of the head output against the first label
+        (the reference's SoftmaxOutput convention: the op emits
+        probabilities, the gradient is p - onehot)."""
+        from . import io as mio
+        from . import ndarray as nd
+
+        data = [nd.array(self._inputs[k]) for k in self._data_names]
+        label = [nd.array(self._inputs[k]) for k in self._label_names]
+        batch = mio.DataBatch(data=data, label=label)
+        self._mod.forward(batch, is_train=True)
+        self._mod.backward()
+        self._mod.update()
+        out = self._mod.get_outputs()[0].asnumpy()
+        if self._label_names:
+            y = self._inputs[self._label_names[0]].astype(np.int64).ravel()
+            p = out.reshape(len(y), -1)
+            eps = 1e-12
+            return float(-np.mean(np.log(p[np.arange(len(y)), y] + eps)))
+        return float(out.mean())
+
+    def forward(self):
+        """Inference forward on the staged inputs (no update)."""
+        from . import io as mio
+        from . import ndarray as nd
+        data = [nd.array(self._inputs[k]) for k in self._data_names]
+        batch = mio.DataBatch(data=data, label=None)
+        self._mod.forward(batch, is_train=False)
+        return 0
+
+    def output_shape(self, index):
+        return tuple(int(d) for d in
+                     self._mod.get_outputs()[index].shape)
+
+    def output_bytes(self, index):
+        return self._mod.get_outputs()[index].asnumpy().astype(
+            np.float32).tobytes()
+
+    def save_params(self):
+        """Serialized .params bytes (MXNet binary, arg:/aux: prefixed)."""
+        from . import ndarray as nd
+        arg_params, aux_params = self._mod.get_params()
+        save_dict = {"arg:%s" % k: v for k, v in arg_params.items()}
+        save_dict.update({"aux:%s" % k: v for k, v in aux_params.items()})
+        with tempfile.NamedTemporaryFile(delete=False) as f:
+            path = f.name
+        try:
+            nd.save(path, save_dict)
+            with open(path, "rb") as f:
+                return f.read()
+        finally:
+            os.unlink(path)
+
+
+def create_trainer(symbol_json, input_shapes, optimizer, optimizer_params,
+                   param_bytes):
+    return CTrainer(symbol_json, input_shapes, optimizer=optimizer,
+                    optimizer_params=optimizer_params or None,
+                    param_bytes=param_bytes or None)

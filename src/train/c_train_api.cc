@@ -1,0 +1,239 @@
+// C train API shim.
+//
+// The training counterpart of c_predict_api.cc — the ABI the reference's
+// non-Python bindings (cpp-package/include/mxnet-cpp/, scala-package/,
+// R-package/) all sit on (SURVEY §1 layer 10).  A C/C++ application can
+// build a trainer from symbol JSON, feed batches, run fused
+// forward+backward+update steps, and read back updated .params bytes —
+// no Python source required at the call site.  The compute engine IS XLA
+// driven through the Python package, so the shim embeds CPython and
+// drives incubator_mxnet_tpu.train_api, the same layering the predict
+// shim uses.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Trainer {
+  PyObject* obj = nullptr;
+};
+
+std::string g_last_error;
+
+void ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+  }
+}
+
+void set_err(const std::string& msg) { g_last_error = msg; }
+
+std::string fetch_py_error() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      msg = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return msg;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXTrainGetLastError() { return g_last_error.c_str(); }
+
+// Create a trainer from symbol JSON.  input_keys/input_shape_* describe
+// every input INCLUDING labels (names ending in "label" bind as label
+// slots, the Module convention).  optimizer_params_json e.g.
+// "{\"learning_rate\": 0.05}".  param_bytes may be null for fresh
+// Xavier-initialized parameters.
+int MXTrainerCreate(const char* symbol_json, const char* optimizer,
+                    const char* optimizer_params_json,
+                    const void* param_bytes, int param_size,
+                    uint32_t num_input_nodes, const char** input_keys,
+                    const uint32_t* input_shape_indptr,
+                    const uint32_t* input_shape_data, void** out) {
+  ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* mod = PyImport_ImportModule("incubator_mxnet_tpu.train_api");
+  if (!mod) {
+    set_err(fetch_py_error());
+    PyGILState_Release(gil);
+    return -1;
+  }
+  PyObject* fn = PyObject_GetAttrString(mod, "create_trainer");
+  PyObject* shapes = PyDict_New();
+  for (uint32_t i = 0; i < num_input_nodes; ++i) {
+    PyObject* shp = PyTuple_New(input_shape_indptr[i + 1] -
+                                input_shape_indptr[i]);
+    for (uint32_t j = input_shape_indptr[i]; j < input_shape_indptr[i + 1];
+         ++j) {
+      PyTuple_SetItem(shp, j - input_shape_indptr[i],
+                      PyLong_FromUnsignedLong(input_shape_data[j]));
+    }
+    PyDict_SetItemString(shapes, input_keys[i], shp);
+    Py_DECREF(shp);
+  }
+  PyObject* params =
+      param_bytes && param_size > 0
+          ? PyBytes_FromStringAndSize(static_cast<const char*>(param_bytes),
+                                      param_size)
+          : (Py_INCREF(Py_None), Py_None);
+  PyObject* res = PyObject_CallFunction(
+      fn, "sOssO", symbol_json, shapes, optimizer,
+      optimizer_params_json ? optimizer_params_json : "", params);
+  Py_DECREF(params);
+  Py_DECREF(shapes);
+  Py_DECREF(fn);
+  Py_DECREF(mod);
+  if (res) {
+    auto* t = new Trainer();
+    t->obj = res;
+    *out = t;
+    rc = 0;
+  } else {
+    set_err(fetch_py_error());
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXTrainerSetInput(void* handle, const char* key, const float* data,
+                      uint32_t size) {
+  auto* t = static_cast<Trainer*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data), size * sizeof(float));
+  PyObject* res = PyObject_CallMethod(t->obj, "set_input", "sO", key, bytes);
+  int rc = res ? 0 : -1;
+  if (!res) set_err(fetch_py_error());
+  Py_XDECREF(res);
+  Py_DECREF(bytes);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// One fused training step on the staged inputs: forward + backward +
+// optimizer update.  *loss receives the batch loss.
+int MXTrainerStep(void* handle, float* loss) {
+  auto* t = static_cast<Trainer*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* res = PyObject_CallMethod(t->obj, "step", nullptr);
+  int rc = -1;
+  if (res) {
+    *loss = static_cast<float>(PyFloat_AsDouble(res));
+    Py_DECREF(res);
+    rc = 0;
+  } else {
+    set_err(fetch_py_error());
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXTrainerForward(void* handle) {
+  auto* t = static_cast<Trainer*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* res = PyObject_CallMethod(t->obj, "forward", nullptr);
+  int rc = res ? 0 : -1;
+  if (!res) set_err(fetch_py_error());
+  Py_XDECREF(res);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXTrainerGetOutputShape(void* handle, uint32_t index,
+                            uint32_t** shape_data, uint32_t* shape_ndim) {
+  auto* t = static_cast<Trainer*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* res = PyObject_CallMethod(t->obj, "output_shape", "I", index);
+  if (!res) {
+    set_err(fetch_py_error());
+    PyGILState_Release(gil);
+    return -1;
+  }
+  Py_ssize_t n = PyTuple_Size(res);
+  static thread_local std::vector<uint32_t> shape_buf;
+  shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    shape_buf[i] = static_cast<uint32_t>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(res, i)));
+  }
+  Py_DECREF(res);
+  *shape_data = shape_buf.data();
+  *shape_ndim = static_cast<uint32_t>(n);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXTrainerGetOutput(void* handle, uint32_t index, float* data,
+                       uint32_t size) {
+  auto* t = static_cast<Trainer*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* res = PyObject_CallMethod(t->obj, "output_bytes", "I", index);
+  if (!res) {
+    set_err(fetch_py_error());
+    PyGILState_Release(gil);
+    return -1;
+  }
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  PyBytes_AsStringAndSize(res, &buf, &len);
+  size_t want = static_cast<size_t>(size) * sizeof(float);
+  std::memcpy(data, buf,
+              len < static_cast<Py_ssize_t>(want) ? static_cast<size_t>(len)
+                                                  : want);
+  Py_DECREF(res);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+// Serialized .params (MXNet binary) of the CURRENT parameters.  The
+// returned pointer stays valid until the next call on any trainer.
+int MXTrainerSaveParams(void* handle, const char** out_bytes,
+                        uint64_t* out_size) {
+  auto* t = static_cast<Trainer*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* res = PyObject_CallMethod(t->obj, "save_params", nullptr);
+  if (!res) {
+    set_err(fetch_py_error());
+    PyGILState_Release(gil);
+    return -1;
+  }
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  PyBytes_AsStringAndSize(res, &buf, &len);
+  static thread_local std::string params_buf;
+  params_buf.assign(buf, static_cast<size_t>(len));
+  Py_DECREF(res);
+  *out_bytes = params_buf.data();
+  *out_size = static_cast<uint64_t>(params_buf.size());
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXTrainerFree(void* handle) {
+  auto* t = static_cast<Trainer*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(t->obj);
+  PyGILState_Release(gil);
+  delete t;
+  return 0;
+}
+
+}  // extern "C"

@@ -157,3 +157,34 @@ def test_async_row_sparse_pull_row_ids():
     np.testing.assert_allclose(dense[1], w[1] + 1)
     np.testing.assert_allclose(dense[4], w[4] + 1)
     assert kv.num_dead_nodes() == 0
+
+
+def test_group_client_discovers_placement_late():
+    """A client that never init/pushed a sharded key must still pull it
+    (review regression: placement lived only in the initializing client;
+    a restarted worker got a server KeyError)."""
+    import os
+    from incubator_mxnet_tpu.parallel import ps
+
+    os.environ["MXTPU_KVSTORE_BIGARRAY_BOUND"] = "100"
+    try:
+        grp = ps.ServerGroup(3)
+        writer = ps.GroupClient(grp.address)
+        rs = np.random.RandomState(1)
+        big = rs.randn(90, 4).astype(np.float32)     # 360 > 100
+        small = rs.randn(5).astype(np.float32)
+        writer.init({"big": big, "small": small})
+
+        fresh = ps.GroupClient(grp.address)          # knows nothing
+        got = fresh.pull(["big", "small"])
+        np.testing.assert_array_equal(got["big"], big)
+        np.testing.assert_array_equal(got["small"], small)
+        rows = fresh.pull_rows({"big": np.array([0, 45, 89], np.int64)})
+        np.testing.assert_array_equal(rows["big"], big[[0, 45, 89]])
+        empty = fresh.pull_rows({"big": np.array([], np.int64)})
+        assert empty["big"].shape == (0, 4)
+        writer.close()
+        fresh.close()
+        grp.shutdown()
+    finally:
+        del os.environ["MXTPU_KVSTORE_BIGARRAY_BOUND"]

@@ -215,3 +215,68 @@ def test_bulk_training_loop_multiple_steps():
         w -= 0.5 * w.grad
         w.grad[:] = 0
     assert losses[-1] < losses[0], losses
+
+
+def test_bulk_detach_alias_keeps_separate_grad_slots():
+    """x and x.detach() share a buffer but must NOT share a gradient slot
+    in a bulked recorded segment (review regression: buffer-id dedup
+    differentiated through the detached alias)."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+
+    def run(bulked):
+        import contextlib
+        x = mx.nd.array(np.array([2.0, 3.0], np.float32))
+        x.attach_grad()
+        scope = mx.engine.bulk(16) if bulked else contextlib.nullcontext()
+        with scope:
+            with autograd.record():
+                xd = x.detach()
+                loss = (x * xd).sum()
+            loss.backward()
+        return x.grad.asnumpy().copy()
+
+    np.testing.assert_array_equal(run(False), run(True))
+
+
+def test_bulk_pause_only_input_grad_untouched():
+    """An input that only fed pause-scope ops inside the segment must not
+    land on the tape node (review regression: its .grad was overwritten
+    with zeros)."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+
+    x = mx.nd.array(np.ones((3,), np.float32))
+    k = mx.nd.array(np.ones((3,), np.float32))
+    x.attach_grad()
+    k.attach_grad()
+    k.grad[:] = 42.0
+    with mx.engine.bulk(16):
+        with autograd.record():
+            y = x * 3.0
+            with autograd.pause():
+                c = k * 2.0
+            z = (y + c).sum()
+        z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 3.0)
+    np.testing.assert_allclose(k.grad.asnumpy(), 42.0)  # untouched
+
+
+def test_bulk_inplace_write_mid_segment_uses_fresh_buffer():
+    """An in-place write between two deferred ops must rebind the ext
+    slot (review regression: owner-keyed dedup returned the stale
+    pre-write buffer)."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+
+    w = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    with mx.engine.bulk(16):
+        y = w * 2.0            # deferred against w's buffer v1
+        w += 1.0               # eager mutating op: w rebinds to v2
+        z = w * 3.0            # must see v2, not the stale slot
+        got_y = y.asnumpy().copy()
+        got_z = z.asnumpy().copy()
+    np.testing.assert_allclose(got_y, [2.0, 4.0])
+    np.testing.assert_allclose(got_z, [6.0, 9.0])

@@ -90,3 +90,56 @@ def test_im2rec_list_and_pack(tmp_path):
     assert len(keys) == 6
     hdr, img = recordio.unpack_img(rec.read_idx(keys[0]))
     assert img.shape[2] == 3 and hdr.label in (0.0, 1.0)
+
+
+def test_rec2idx_roundtrip(tmp_path):
+    """tools/rec2idx.py: an index built from a bare .rec enables read_idx
+    random access identical to the write-time index."""
+    import importlib.util
+    import os
+    import numpy as np
+    from incubator_mxnet_tpu import recordio
+
+    rec = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = recordio.MXRecordIO(rec, "w")
+    payloads = [b"rec-%d-" % i + bytes(np.arange(i % 7, dtype=np.uint8))
+                for i in range(9)]
+    for pl in payloads:
+        w.write(pl)
+    w.close()
+
+    spec = importlib.util.spec_from_file_location(
+        "rec2idx", os.path.join(os.path.dirname(__file__), "..",
+                                "tools", "rec2idx.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.create_index(rec, idx) == len(payloads)
+
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    for i in (0, 4, 8, 2):
+        assert r.read_idx(i) == payloads[i]
+    r.close()
+
+
+def test_parse_log_markdown(tmp_path):
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "parse_log", os.path.join(os.path.dirname(__file__), "..",
+                                  "tools", "parse_log.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    lines = [
+        "INFO:root:Epoch[0] Train-accuracy=0.5",
+        "INFO:root:Epoch[0] Time cost=12.5",
+        "INFO:root:Epoch[0] Validation-accuracy=0.55",
+        "INFO:root:Epoch[1] Train-accuracy=0.75",
+        "INFO:root:Epoch[1] Time cost=11.0",
+    ]
+    data, cols = mod.parse(lines)
+    assert data[0]["train-accuracy"] == 0.5
+    assert data[0]["val-accuracy"] == 0.55
+    assert data[1]["time"] == 11.0
+    md = mod.to_markdown(data, cols)
+    assert md.startswith("| epoch |") and "| 1 | 0.75" in md

@@ -1,0 +1,60 @@
+"""Parse a training log into a markdown table
+(ref: tools/parse_log.py — same Epoch[N] Train-/Validation-/Time regex
+family over the Speedometer/fit log format this framework emits).
+
+Usage:
+    python tools/parse_log.py train.log
+    python tools/parse_log.py train.log --metric-names accuracy top_k_accuracy
+"""
+import argparse
+import re
+
+
+def parse(lines, metric_names=("accuracy",)):
+    """{epoch: {column: value}} from fit/Speedometer log lines."""
+    pats = []
+    for s in metric_names:
+        pats.append(("train-" + s,
+                     re.compile(r".*Epoch\[(\d+)\] Train-" + s
+                                + r".*=([.\d]+)")))
+        pats.append(("val-" + s,
+                     re.compile(r".*Epoch\[(\d+)\] Validation-" + s
+                                + r".*=([.\d]+)")))
+    pats.append(("time", re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)")))
+    data = {}
+    for line in lines:
+        for col, pat in pats:
+            m = pat.match(line)
+            if m:
+                epoch, val = int(m.group(1)), float(m.group(2))
+                data.setdefault(epoch, {})[col] = val
+                break
+    return data, [c for c, _ in pats]
+
+
+def to_markdown(data, cols):
+    out = ["| epoch | " + " | ".join(cols) + " |",
+           "| --- |" + " --- |" * len(cols)]
+    for epoch in sorted(data):
+        row = data[epoch]
+        out.append("| %d | " % epoch
+                   + " | ".join("%.6g" % row[c] if c in row else ""
+                                for c in cols) + " |")
+    return "\n".join(out)
+
+
+def main():
+    p = argparse.ArgumentParser(description="Parse training output log")
+    p.add_argument("logfile", type=str)
+    p.add_argument("--format", default="markdown",
+                   choices=["markdown", "none"])
+    p.add_argument("--metric-names", nargs="+", default=["accuracy"])
+    args = p.parse_args()
+    with open(args.logfile) as f:
+        data, cols = parse(f.readlines(), args.metric_names)
+    if args.format == "markdown":
+        print(to_markdown(data, cols))
+
+
+if __name__ == "__main__":
+    main()

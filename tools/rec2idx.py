@@ -1,0 +1,46 @@
+"""Create a .idx index for an existing .rec file, enabling random access
+(ref: tools/rec2idx.py — the reference walks the RecordIO stream with
+tell() before each read and writes ``key\\toffset`` lines).
+
+Usage:
+    python tools/rec2idx.py data/test.rec data/test.idx
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..")))
+
+from incubator_mxnet_tpu import recordio  # noqa: E402
+
+
+def create_index(rec_path, idx_path, key_type=int):
+    """Walk the stream; record each record's byte offset under running
+    integer keys (the im2rec convention)."""
+    reader = recordio.MXRecordIO(rec_path, "r")
+    n = 0
+    with open(idx_path, "w") as fidx:
+        while True:
+            pos = reader.tell()
+            item = reader.read()
+            if item is None:
+                break
+            fidx.write("%s\t%d\n" % (key_type(n), pos))
+            n += 1
+    reader.close()
+    return n
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="Create an index file for a RecordIO file")
+    p.add_argument("record", help="path to the .rec file")
+    p.add_argument("index", help="path for the .idx file to create")
+    args = p.parse_args()
+    n = create_index(args.record, args.index)
+    print("wrote %d entries to %s" % (n, args.index))
+
+
+if __name__ == "__main__":
+    main()
