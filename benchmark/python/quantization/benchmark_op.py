@@ -50,16 +50,26 @@ FC_CONFIGS = [
     (32, 2048, 1000),
     (256, 2048, 1000),
     (256, 4096, 4096),
+    # large enough to clear the per-iteration latency floor and expose
+    # the MXU's double-rate int8 pipeline (the reference shapes above
+    # all finish under it on this chip)
+    (8192, 8192, 8192),
 ]
 
 
 def _timed_scan(fn, *args, repeats=None):
     """Jit a scan of ``fn``; return ms/call.
 
-    Each iteration's inputs pass through an ``optimization_barrier`` tied
-    to the previous iteration's output, so XLA can neither hoist the
-    (otherwise loop-invariant) op out of the loop nor CSE the calls; the
-    final scalar fetch is the true sync point on the axon tunnel.
+    Each iteration rebinds the first operand through a SELECT on a
+    runtime predicate of the previous output (always false, but not
+    provably so) — a data dependency XLA can neither hoist nor
+    distribute through the op.  Scalar add/mul perturbations are NOT
+    enough: XLA rewrites ``(a+eps)@b`` as ``a@b + eps@b`` and hoists
+    ``a@b`` (measured: 8192^3 matmuls "ran" at 2x the chip's dense
+    ceiling); an optimization_barrier alone fared even worse.  The
+    select costs one elementwise pass per iteration — small vs any op
+    worth benchmarking here.  Final scalar fetch = true sync on the
+    axon tunnel.
     """
     if repeats is None:
         repeats = REPEATS   # read at call time so tests can shrink it
@@ -70,7 +80,9 @@ def _timed_scan(fn, *args, repeats=None):
             out = fn(*carry)
             lead = out[0] if isinstance(out, tuple) else out
             probe = lead.reshape(-1)[0].astype(jnp.float32)
-            carry, probe = jax.lax.optimization_barrier((carry, probe))
+            first = jnp.where(probe > 1e30, carry[0] + carry[0].dtype.type(1),
+                              carry[0])
+            carry = (first,) + carry[1:]
             return carry, probe
         _, probes = jax.lax.scan(body, a, None, length=repeats)
         return probes.sum()
@@ -150,11 +162,74 @@ def bench_fc(batch, in_features, num_hidden):
     return results
 
 
+def bench_serial_matmul(n=8192, repeats=30):
+    """The conclusive int8-vs-bf16 probe: each iteration's matmul consumes
+    the previous OUTPUT (renormalized), a dependency XLA cannot hoist or
+    algebraically distribute away — unlike scalar-perturbation chains,
+    which XLA rewrites as ``a@b + eps@b`` and hoists (measured 2x-fake
+    throughput).  Same methodology for both dtypes, so the RATIO is
+    solid even where absolute numbers carry the renorm pass."""
+    key = jax.random.PRNGKey(0)
+    results = {}
+    for name, dt in (("bf16", jnp.bfloat16), ("int8", jnp.int8)):
+        if dt == jnp.int8:
+            a = (jax.random.normal(key, (n, n)) * 10).astype(jnp.int8)
+            b = (jax.random.normal(key, (n, n)) * 10).astype(jnp.int8)
+
+            def mm(x, y):
+                return jax.lax.dot_general(
+                    x, y, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+
+            def norm(o):
+                return (o >> 8).astype(jnp.int8)
+        else:
+            a = jax.random.normal(key, (n, n), dt)
+            b = jax.random.normal(key, (n, n), dt)
+
+            def mm(x, y):
+                return x @ y
+
+            def norm(o):
+                return o * jnp.float32(1e-4).astype(o.dtype)
+
+        @jax.jit
+        def many(a, b):
+            def body(carry, _):
+                out = mm(carry, b)
+                return norm(out), out.reshape(-1)[0].astype(jnp.float32)
+            _, probes = jax.lax.scan(body, a, None, length=repeats)
+            return probes.sum()
+
+        float(many(a, b))
+        t0 = time.perf_counter()
+        float(many(a, b))
+        dt_s = time.perf_counter() - t0
+        results[name] = {
+            "ms": dt_s / repeats * 1e3,
+            "tops": 2 * n ** 3 * repeats / dt_s / 1e12,
+        }
+    return results
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--fc", action="store_true", help="include FC sweep")
     p.add_argument("--conv", action="store_true", help="include conv sweep")
+    p.add_argument("--serial-probe", action="store_true",
+                   help="serial-chain 8192^3 matmul: the conclusive "
+                        "int8-vs-bf16 ratio")
     args = p.parse_args()
+    if args.serial_probe:
+        r = bench_serial_matmul()
+        print(json.dumps({
+            "op": "serial_matmul_8192", "bf16_ms": round(r["bf16"]["ms"], 2),
+            "int8_ms": round(r["int8"]["ms"], 2),
+            "bf16_tflops": round(r["bf16"]["tops"], 1),
+            "int8_tops": round(r["int8"]["tops"], 1),
+            "int8_vs_bf16": round(r["bf16"]["ms"] / r["int8"]["ms"], 2),
+        }), flush=True)
+        return      # standalone measurement: no implicit sweeps after it
     do_conv = args.conv or not args.fc
     if do_conv:
         for cfg in CONV_CONFIGS:

@@ -28,6 +28,10 @@ std::string g_last_error;
 void ensure_python() {
   if (!Py_IsInitialized()) {
     Py_InitializeEx(0);
+    // release the GIL the init call leaves held: every entry point takes
+    // it via PyGILState_Ensure, and keeping it here would deadlock any
+    // OTHER thread's first call into this ABI
+    PyEval_SaveThread();
   }
 }
 
