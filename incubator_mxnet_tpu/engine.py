@@ -239,13 +239,21 @@ def _build_replay(instrs, live):
 def _rec_reachable_ext(instrs):
     """Ext slots whose gradient path reaches a recorded instruction
     through recorded-op chains only (stop_gradient blocks every other
-    path, so those slots are the exact tape-input set)."""
+    path, so those slots are the exact tape-input set).  Inputs an op
+    declares ``nograd_inputs`` never receive gradient in eager backward
+    (_run_backward's per-op skip), so slots reaching recorded ops SOLELY
+    through such positions are excluded too — e.g. BatchNorm's
+    moving_mean/moving_var (inputs 3-4) must not land on the tape node."""
+    from .ops.registry import get_op
     ext_slots = set()
     pend_deps = []
-    for _name, _p, _k, _train, in_refs, _rng, n_out, rec in instrs:
+    for name, _p, _k, _train, in_refs, _rng, n_out, rec in instrs:
         if rec:
+            nograd = set(get_op(name).nograd_inputs)
             deps = set()
-            for tag, i in in_refs:
+            for pos, (tag, i) in enumerate(in_refs):
+                if pos in nograd:
+                    continue
                 if tag == "e":
                     deps.add(i)
                 else:

@@ -302,13 +302,56 @@ class DistKVStore(KVStore):
                 np.asarray(red.indices._read()).dtype))
             return red
         # CSR (and any future stype): reduce dense, rebuild the compressed
-        # form host-side — CSR pushes are rare enough that clarity wins
-        dense = np.asarray(_global_sum(red._read().ravel())).reshape(red.shape)
-        r, c = np.nonzero(dense)
-        red.data = NDArray(jnp.asarray(dense[r, c]))
-        red.indices = NDArray(jnp.asarray(c.astype(np.int64)))
+        # form host-side — CSR pushes are rare enough that clarity wins.
+        # GUARD: the densify materializes rows*cols on every worker, so
+        # above MXTPU_CSR_DENSIFY_BOUND bytes (default 256MB) it switches
+        # to a chunked row-band path — each band is densified, summed and
+        # re-sparsified separately, bounding peak host memory at the band
+        # size.  Band count derives only from shape+bound, so every rank
+        # issues the same collective sequence (lockstep contract).
+        import os
+        import warnings
+        bound = int(os.environ.get("MXTPU_CSR_DENSIFY_BOUND", str(1 << 28)))
+        nbytes = int(np.prod(red.shape)) * np.dtype(red.dtype).itemsize
+        if nbytes <= bound:
+            dense = np.asarray(_global_sum(
+                red._read().ravel())).reshape(red.shape)
+            r, c = np.nonzero(dense)
+            red.data = NDArray(jnp.asarray(dense[r, c]))
+            red.indices = NDArray(jnp.asarray(c.astype(np.int64)))
+            red.indptr = NDArray(jnp.asarray(np.searchsorted(
+                r, np.arange(red.shape[0] + 1)).astype(np.int64)))
+            return red
+        warnings.warn(
+            "CSR cross-worker reduce of %s (%d bytes dense) exceeds "
+            "MXTPU_CSR_DENSIFY_BOUND=%d; using the chunked row-band path "
+            "(slower, bounded memory)" % (red.shape, nbytes, bound))
+        nrows, ncols = red.shape
+        row_bytes = ncols * np.dtype(red.dtype).itemsize
+        band = max(1, bound // max(row_bytes, 1))
+        indptr = np.asarray(red.indptr._read()).astype(np.int64)
+        indices = np.asarray(red.indices._read()).astype(np.int64)
+        data = np.asarray(red.data._read())
+        cs, vs, ptr_parts = [], [], [np.zeros(1, np.int64)]
+        for r0 in range(0, nrows, band):
+            r1 = min(r0 + band, nrows)
+            ptr = indptr[r0:r1 + 1]
+            dense_b = np.zeros((r1 - r0, ncols), data.dtype)
+            if ptr[-1] > ptr[0]:
+                rows = np.repeat(np.arange(r0, r1), np.diff(ptr)) - r0
+                dense_b[rows, indices[ptr[0]:ptr[-1]]] = \
+                    data[ptr[0]:ptr[-1]]
+            dense_b = np.asarray(_global_sum(
+                dense_b.ravel())).reshape(r1 - r0, ncols)
+            r, c = np.nonzero(dense_b)
+            cs.append(c)
+            vs.append(dense_b[r, c])
+            ptr_parts.append(ptr_parts[-1][-1] + np.searchsorted(
+                r, np.arange(1, r1 - r0 + 1)).astype(np.int64))
+        red.data = NDArray(jnp.asarray(np.concatenate(vs)))
+        red.indices = NDArray(jnp.asarray(np.concatenate(cs)))
         red.indptr = NDArray(jnp.asarray(
-            np.searchsorted(r, np.arange(red.shape[0] + 1)).astype(np.int64)))
+            np.concatenate(ptr_parts)))
         return red
 
     def _cross_worker_reduce_many(self, reds):

@@ -30,9 +30,12 @@ requested rows (kvstore_dist_server.h:223 row_sparse handling).
 
 SECURITY: the wire is UNAUTHENTICATED pickled TCP — deserializing a
 pickle executes arbitrary code, so anyone who can reach the port owns
-the process.  Bind only on trusted/isolated networks (the same trust
-model ps-lite's plain ZMQ wire assumes); this transport is a
-prototype-grade stand-in, not a hardened service.
+the process.  Single-host runs therefore bind loopback by default; the
+server only listens on 0.0.0.0 when multi-host env vars are present
+(MX_PS_HOST, or a remote MX_COORDINATOR), and MX_PS_BIND overrides the
+choice.  Bind only on trusted/isolated networks (the same trust model
+ps-lite's plain ZMQ wire assumes); this transport is a prototype-grade
+stand-in, not a hardened service.
 """
 from __future__ import annotations
 
@@ -67,6 +70,28 @@ def _advertised_host():
         return "127.0.0.1"
 
 
+def _default_bind_host():
+    """Pick the listening interface: MX_PS_BIND wins; any launched
+    distributed run (MX_PS_HOST, MX_COORDINATOR, or an initialized
+    multi-process jax.distributed) must accept external connections;
+    otherwise keep the wire on loopback — the pickle protocol is
+    unauthenticated, so a plain single-process run should never expose
+    a network-reachable port."""
+    import os
+    env = os.environ.get("MX_PS_BIND")
+    if env:
+        return env
+    if os.environ.get("MX_PS_HOST") or os.environ.get("MX_COORDINATOR"):
+        return "0.0.0.0"
+    try:
+        import jax
+        if jax.process_count() > 1:
+            return "0.0.0.0"
+    except Exception:
+        pass
+    return "127.0.0.1"
+
+
 def _send_msg(sock, obj):
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     sock.sendall(_LEN.pack(len(payload)) + payload)
@@ -92,15 +117,20 @@ class ParameterServer(object):
     the reference would run it in dedicated server processes; one thread
     suffices for the single-server topology)."""
 
-    def __init__(self, host="0.0.0.0", port=0):
+    def __init__(self, host=None, port=0):
         self._store = {}          # key -> np.ndarray (authoritative)
         self._updater = None      # (key:int, grad, weight) -> None, in place
         self._beats = {}          # worker rank -> last heartbeat time
         self._lock = threading.Lock()
+        if host is None:
+            host = _default_bind_host()
         self._srv = socket.create_server((host, port))
         # advertise a ROUTABLE address (multi-host workers must reach it;
-        # loopback would only ever work same-machine)
-        adv = _advertised_host()
+        # loopback would only ever work same-machine).  When bound to
+        # loopback the advertised address must be loopback too — the
+        # LAN-interface IP would route to a closed port.
+        loopback = host in ("127.0.0.1", "localhost", "::1")
+        adv = "127.0.0.1" if loopback else _advertised_host()
         self.address = "%s:%d" % (adv, self._srv.getsockname()[1])
         self._stop = False
         self._thread = threading.Thread(target=self._serve, daemon=True)

@@ -44,7 +44,16 @@ std::string fetch_py_error() {
   if (value) {
     PyObject* s = PyObject_Str(value);
     if (s) {
-      msg = PyUnicode_AsUTF8(s);
+      // PyUnicode_AsUTF8 returns nullptr for non-UTF8-encodable text;
+      // keep the fallback message rather than constructing from nullptr
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) {
+        msg = c;
+      } else {
+        // non-UTF8-encodable text: AsUTF8 left a UnicodeEncodeError
+        // pending, which would poison the next C-API call
+        PyErr_Clear();
+      }
       Py_DECREF(s);
     }
   }

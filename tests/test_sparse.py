@@ -170,3 +170,31 @@ def test_csr_row_slicing():
         want = dense[key if isinstance(key, slice) else slice(key, key + 1)]
         assert sl.stype == "csr"
         np.testing.assert_allclose(sl.todense().asnumpy(), want)
+
+
+def test_csr_reduce_densify_guard(monkeypatch):
+    """The cross-worker CSR reduce must not materialize an unbounded
+    dense matrix: above MXTPU_CSR_DENSIFY_BOUND it warns and switches to
+    the chunked row-band path, whose result must equal the direct path
+    (single-process: the reduce is identity, so chunking correctness is
+    exactly what's exercised)."""
+    from incubator_mxnet_tpu import kvstore as kvs
+    kv = kvs.create("dist_sync")
+    rs = np.random.RandomState(7)
+    dense = ((rs.rand(64, 48) < 0.15) * rs.randn(64, 48)).astype(np.float32)
+    # direct path (bound far above the matrix size)
+    monkeypatch.setenv("MXTPU_CSR_DENSIFY_BOUND", str(1 << 30))
+    ref = kv._cross_worker_reduce_sparse(mx.nd.array(dense).tostype("csr"))
+    np.testing.assert_allclose(ref.todense().asnumpy(), dense, rtol=1e-6)
+    # guard path: bound below one full densify -> warning + row bands
+    monkeypatch.setenv("MXTPU_CSR_DENSIFY_BOUND",
+                       str(10 * 48 * 4))   # ~10 rows per band
+    with pytest.warns(UserWarning, match="MXTPU_CSR_DENSIFY_BOUND"):
+        out = kv._cross_worker_reduce_sparse(
+            mx.nd.array(dense).tostype("csr"))
+    assert out.stype == "csr"
+    np.testing.assert_allclose(out.todense().asnumpy(), dense, rtol=1e-6)
+    np.testing.assert_array_equal(out.indptr.asnumpy(),
+                                  ref.indptr.asnumpy())
+    np.testing.assert_array_equal(out.indices.asnumpy(),
+                                  ref.indices.asnumpy())

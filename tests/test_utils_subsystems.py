@@ -143,3 +143,10 @@ def test_parse_log_markdown(tmp_path):
     assert data[1]["time"] == 11.0
     md = mod.to_markdown(data, cols)
     assert md.startswith("| epoch |") and "| 1 | 0.75" in md
+    # scientific notation + regex-special metric names (round-4 advisor)
+    data2, _ = mod.parse(["INFO:root:Epoch[2] Train-loss=1e-05"], ("loss",))
+    assert data2[2]["train-loss"] == 1e-05
+    data3, _ = mod.parse(
+        ["INFO:root:Epoch[0] Train-top_k_accuracy_5=0.9"],
+        ("top_k_accuracy_5",))
+    assert data3[0]["train-top_k_accuracy_5"] == 0.9

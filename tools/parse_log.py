@@ -12,15 +12,16 @@ import re
 
 def parse(lines, metric_names=("accuracy",)):
     """{epoch: {column: value}} from fit/Speedometer log lines."""
+    num = r"=([-+]?[.\d]+(?:[eE][-+]?\d+)?)"
     pats = []
     for s in metric_names:
         pats.append(("train-" + s,
-                     re.compile(r".*Epoch\[(\d+)\] Train-" + s
-                                + r".*=([.\d]+)")))
+                     re.compile(r".*Epoch\[(\d+)\] Train-" + re.escape(s)
+                                + r".*" + num)))
         pats.append(("val-" + s,
-                     re.compile(r".*Epoch\[(\d+)\] Validation-" + s
-                                + r".*=([.\d]+)")))
-    pats.append(("time", re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)")))
+                     re.compile(r".*Epoch\[(\d+)\] Validation-" + re.escape(s)
+                                + r".*" + num)))
+    pats.append(("time", re.compile(r".*Epoch\[(\d+)\] Time.*" + num)))
     data = {}
     for line in lines:
         for col, pat in pats:
