@@ -12,10 +12,15 @@ Whole train step (fwd + CE loss + bwd + SGD-momentum update, bf16 compute
 with f32 master weights) is ONE jitted XLA program via
 DataParallelTrainer.
 
-MFU convention (PaLM appendix B): model FLOPs = 6 * n_params * tokens
-plus the causal attention term 6 * S * tokens * d_model (QK^T and PV,
-halved for causality, x3 for fwd+bwd) — flash recompute in the backward
-is NOT counted (it is overhead, not model work).
+MFU convention (PaLM appendix B): model FLOPs = 6 * N * tokens with N =
+NON-embedding parameters (the input token/position tables are gathers —
+0 matmul FLOPs — so counting them would inflate MFU ~7% at the default
+config; the vocab-projection head IS a matmul and stays in N), plus the
+causal attention term 6 * S * tokens * d_model (QK^T and PV, halved for
+causality, x3 for fwd+bwd) — flash recompute in the backward is NOT
+counted (it is overhead, not model work).  The JSON reports both
+conventions: "mfu" (non-embedding, headline) and "mfu_all_params" (the
+pre-round-5 number, for comparability).
 
 Prints ONE JSON line:
   {"metric": "transformer_lm_train_tokens_per_sec", "value": N,
@@ -169,24 +174,32 @@ def main():
 
     n_params = int(sum(int(np.prod(p.shape))
                        for p in net.collect_params().values()))
+    # input embedding + position table are gathers, not matmuls: exclude
+    # from the FLOP model (PaLM appendix B non-embedding convention)
+    n_embed = vocab * d_model + seq_len * d_model
+    n_matmul = n_params - n_embed
     tokens = batch * seq_len
     tok_s = n_steps * tokens / dt
-    flops = model_flops_per_step(n_params, tokens, seq_len, d_model,
+    flops = model_flops_per_step(n_matmul, tokens, seq_len, d_model,
                                  n_layers)
+    flops_all = model_flops_per_step(n_params, tokens, seq_len, d_model,
+                                     n_layers)
     achieved_tflops = flops * n_steps / dt / 1e12
     kind = jax.devices()[0].device_kind
     peak = float(os.environ.get("BENCH_PEAK_TFLOPS",
                                 _PEAK_TFLOPS.get(kind, 0.0)))
     mfu = achieved_tflops / peak if peak else None
+    mfu_all = (flops_all * n_steps / dt / 1e12) / peak if peak else None
 
     print(json.dumps({
         "metric": "transformer_lm_train_tokens_per_sec",
         "value": round(tok_s, 1),
         "unit": "tokens/s",
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "mfu_all_params": round(mfu_all, 4) if mfu_all is not None else None,
         "tflops_per_sec": round(achieved_tflops, 2),
         "peak_tflops": peak, "device_kind": kind,
-        "n_params": n_params,
+        "n_params": n_params, "n_params_non_embedding": n_matmul,
         "d_model": d_model, "n_layers": n_layers, "n_heads": n_heads,
         "d_ffn": d_ffn, "seq_len": seq_len, "batch": batch,
         "step_ms": round(dt / n_steps * 1e3, 2),
