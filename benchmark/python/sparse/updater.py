@@ -5,6 +5,13 @@ Times SGD updates on a large embedding-style weight when the gradient is
 row-sparse (the lazy path touches only occupied rows — optimizer.py
 _sparse_sgd, the analogue of SGDUpdateRspRspImpl) vs the same gradient
 densified.  Prints JSON lines.
+
+``--bulk N``: run each update stream inside ``mx.engine.bulk`` so N
+consecutive updates flush as ONE XLA dispatch — the configuration that
+matters for training loops (the reference bulks optimizer updates inside
+train segments, threaded_engine.h:472-509).  Without it the lazy path
+pays per-op dispatch floors that dwarf its bandwidth win on this
+transport (docs/bench_results_r04/README.md:89).
 """
 import argparse
 import json
@@ -28,17 +35,35 @@ CONFIGS = [
 ]
 
 
-def measure(f, repeat=10):
-    f()
+def measure(update, sync, repeat=10, bulk=0):
+    """ms per update.  bulk mode: N updates recorded per segment, one
+    flush per scope exit, sync OUTSIDE the scope (a sync inside would
+    materialize and break the segment)."""
+    if bulk:
+        def run():
+            with mx.engine.bulk(bulk + 1):
+                for _ in range(bulk):
+                    update()
+            sync()
+        run()                       # warm-up (compile the replay)
+        t0 = time.perf_counter()
+        run()
+        return (time.perf_counter() - t0) / bulk
+    # non-bulk: sync EVERY update (the round-4 methodology — per-dispatch
+    # latency is part of what this mode measures)
+    update(); sync()
     t0 = time.perf_counter()
     for _ in range(repeat):
-        f()
+        update()
+        sync()
     return (time.perf_counter() - t0) / repeat
 
 
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--repeat", type=int, default=10)
+    p.add_argument("--bulk", type=int, default=0,
+                   help="defer N updates per XLA dispatch via engine.bulk")
     args = p.parse_args()
     rs = np.random.RandomState(0)
     for rows, cols, frac in CONFIGS:
@@ -54,13 +79,13 @@ def main():
         w_lazy = mx.nd.array(rs.randn(rows, cols).astype(np.float32))
         w_dense = mx.nd.array(w_lazy.asnumpy())
 
-        t_lazy = measure(lambda: (opt.update(0, w_lazy, grad_rsp, None),
-                                  w_lazy.wait_to_read()), args.repeat)
-        t_dense = measure(lambda: (opt.update(1, w_dense, grad_dense, None),
-                                   w_dense.wait_to_read()), args.repeat)
+        t_lazy = measure(lambda: opt.update(0, w_lazy, grad_rsp, None),
+                         w_lazy.wait_to_read, args.repeat, args.bulk)
+        t_dense = measure(lambda: opt.update(1, w_dense, grad_dense, None),
+                          w_dense.wait_to_read, args.repeat, args.bulk)
         print(json.dumps({
             "op": "sgd_update", "weight_shape": [rows, cols],
-            "occupied_frac": frac,
+            "occupied_frac": frac, "bulk": args.bulk,
             "lazy_rsp_ms": round(t_lazy * 1e3, 3),
             "dense_ms": round(t_dense * 1e3, 3),
             "lazy_speedup": round(t_dense / t_lazy, 2),

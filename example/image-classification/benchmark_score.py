@@ -2,14 +2,26 @@
 reference's scoring sweep (ref: example/image-classification/
 benchmark_score.py:1-66, numbers in docs/faq/perf.md:122-144).
 
-The TPU-native inference path: a hybridized Gluon zoo model — the whole
-forward compiles to ONE XLA program via CachedOp — driven batch after
-batch.  Sync discipline: the device stream executes dispatches in order,
-so a host fetch of (one element of) the LAST batch's output bounds the
-whole timed region; ``wait_to_read``/``block_until_ready`` alone does
-not reliably synchronize through the axon tunnel (bench.py discipline).
-bf16 by default: inference has no master-weight concern and the MXU
-doubles bf16 throughput.
+Two measurement modes:
+
+* ``--mode steady`` (default): CHIP-TRUE.  The hybridized forward is
+  functionalized (``gluon.block.functionalize``) and ``lax.scan``-chained
+  K times inside ONE XLA program, each iteration's input perturbed by a
+  scalar probe of the previous iteration's output — a data dependence
+  XLA can neither hoist out of the loop (LICM needs loop-invariance) nor
+  batch away, so the timed region is K back-to-back forwards with ONE
+  dispatch.  This defeats the axon tunnel's per-dispatch floor (~100 ms+,
+  docs/perf_analysis_r03.md) that made the round-4 eager sweep read
+  resnet-152 faster than resnet-50: transport noise divides by K.
+* ``--mode eager``: one dispatch per batch through the stock
+  CachedOp path — measures the FRAMEWORK serving path including
+  per-call overhead (the number a latency-sensitive user sees), kept
+  for comparability with the round-4 table.
+
+Sync discipline (both modes): host fetch of a value data-dependent on
+all timed work; ``block_until_ready`` alone does not reliably sync
+through the axon tunnel.  bf16 by default: inference has no
+master-weight concern and the MXU doubles bf16 throughput.
 
 Usage:
     python benchmark_score.py                  # full sweep, JSON lines
@@ -42,8 +54,7 @@ NETWORKS = {
 }
 
 
-def score(network, batch_size, num_batches=10, dtype="bfloat16"):
-    """img/s for one (network, batch) point; warm-up excluded."""
+def _build(network, batch_size, dtype):
     factory, size = NETWORKS[network]
     mx.random.seed(0)
     net = getattr(vision, factory)(classes=1000)
@@ -51,12 +62,57 @@ def score(network, batch_size, num_batches=10, dtype="bfloat16"):
     if dtype not in ("float32", "none", None):
         net.cast(dtype)
     net.hybridize()
-
     rs = np.random.RandomState(0)
     x = mx.nd.array(rs.uniform(-1, 1, (batch_size, 3, size, size))
                     .astype(np.float32))
     if dtype not in ("float32", "none", None):
         x = x.astype(dtype)
+    return net, x
+
+
+def score_steady(network, batch_size, chain=100, repeats=2,
+                 dtype="bfloat16", fn_params=None, x=None):
+    """img/s with the per-dispatch floor amortized over ``chain`` chained
+    forwards in one XLA program.  ``fn_params``/``x`` override the model
+    (used by the quantization bench to time an already-transformed
+    forward through the identical harness)."""
+    import jax
+    import jax.numpy as jnp
+
+    if fn_params is None:
+        from incubator_mxnet_tpu.gluon.block import functionalize
+        net, xin = _build(network, batch_size, dtype)
+        fn, params = functionalize(net, xin)
+        x = xin._read()
+    else:
+        fn, params = fn_params
+
+    @jax.jit
+    def chained(params, x0):
+        def body(carry, _):
+            out = fn(params, x0 + carry)
+            # scalar probe of THIS output feeds the NEXT input: the loop
+            # body is not loop-invariant, so XLA executes all K forwards.
+            # 1e-20 keeps the perturbation sub-ULP for realistic inputs
+            # (and is exactly representable in bf16's f32 exponent range)
+            p = out.reshape(-1)[0].astype(jnp.float32)
+            return (p * 1e-20).astype(x0.dtype), p
+        _, probes = jax.lax.scan(
+            body, jnp.zeros((), x0.dtype), None, length=chain)
+        return probes.sum()
+
+    float(chained(params, x))                # compile + warm
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(chained(params, x))            # host fetch = true sync
+        best = min(best, time.perf_counter() - t0)
+    return chain * batch_size / best
+
+
+def score_eager(network, batch_size, num_batches=10, dtype="bfloat16"):
+    """img/s, one dispatch per batch (includes per-call overhead)."""
+    net, x = _build(network, batch_size, dtype)
 
     def sync(out):
         # in-order device stream: fetching one element of the last output
@@ -81,7 +137,11 @@ def main():
                    help="one of %s (default: all)" % ", ".join(NETWORKS))
     p.add_argument("--batch-size", type=int, default=0,
                    help="single batch size (default: sweep 1 and 32)")
-    p.add_argument("--num-batches", type=int, default=10)
+    p.add_argument("--mode", default="steady", choices=["steady", "eager"])
+    p.add_argument("--chain", type=int, default=100,
+                   help="forwards per dispatch in steady mode")
+    p.add_argument("--num-batches", type=int, default=10,
+                   help="batches to time in eager mode")
     p.add_argument("--dtype", default="bfloat16")
     args = p.parse_args()
 
@@ -89,11 +149,16 @@ def main():
     batches = [args.batch_size] if args.batch_size else [1, 32]
     for network in networks:
         for b in batches:
-            img_s = score(network, b, args.num_batches, args.dtype)
+            if args.mode == "steady":
+                img_s = score_steady(network, b, args.chain,
+                                     dtype=args.dtype)
+            else:
+                img_s = score_eager(network, b, args.num_batches,
+                                    args.dtype)
             print(json.dumps({
                 "metric": "inference_imgs_per_sec", "network": network,
                 "batch_size": b, "value": round(img_s, 2), "unit": "img/s",
-                "dtype": args.dtype,
+                "dtype": args.dtype, "mode": args.mode,
             }), flush=True)
 
 

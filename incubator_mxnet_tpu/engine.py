@@ -27,9 +27,16 @@ of N + 2N.  Ops that ran under ``autograd.pause()`` inside the segment
 are wrapped in ``stop_gradient`` so the tape semantics match eager
 execution exactly.
 
+``out=`` stores and mutating ops (optimizer updates) ARE deferrable
+(round 5, matching the reference's bulking of optimizer updates inside
+train segments): the write target's buffer is rebound to the pending
+output at record time, provided the target is a plain non-view NDArray
+and the inferred output matches its shape/dtype exactly — otherwise the
+op runs eagerly with the usual astype/write-through fixups.
+
 Out of scope for deferral (dispatched eagerly, exactly as before):
-``out=`` stores, mutating ops (optimizer updates), sparse storage, ops
-that manage their own mesh placement (no_jit), and NaiveEngine mode.
+recorded ops with ``out=``, sparse storage, ops that manage their own
+mesh placement (no_jit), and NaiveEngine mode.
 VIEW creation (reshape/slice) over a deferred value materializes it —
 views share storage with their base, which must be concrete for
 write-through; keep chains view-free for maximal segments.
@@ -143,12 +150,18 @@ class bulk(object):
             _tls.state = self._prev
 
 
-def maybe_defer(op, params, vals, is_train, kw, rec=False, nd_inputs=None):
+def maybe_defer(op, params, vals, is_train, kw, rec=False, nd_inputs=None,
+                out_reqs=None):
     """Called from the eager invoke: record the op if a bulk scope is
     active and every input is deferrable.  Returns a tuple of _Pending
     outputs, or None to dispatch eagerly.  ``rec`` marks ops being taped
     by autograd: the flush builds one tape node for the whole segment;
-    ``nd_inputs`` are the NDArray wrappers (gradient delivery targets)."""
+    ``nd_inputs`` are the NDArray wrappers (gradient delivery targets).
+    ``out_reqs`` — [(slot, shape, dtype_str)] constraints from ``out=``
+    write targets: deferral is refused (BEFORE anything is recorded)
+    unless the inferred output matches exactly, because a deferred store
+    rebinds the target's buffer without the eager path's astype/reshape
+    fixups."""
     st = _current()
     if st is None:
         return None
@@ -181,6 +194,13 @@ def maybe_defer(op, params, vals, is_train, kw, rec=False, nd_inputs=None):
         except Exception:
             return None           # shape inference failed: run eagerly
         _infer_cache[ikey] = out_sig
+    if out_reqs is not None:
+        for slot, shp, dt in out_reqs:
+            if slot >= len(out_sig):
+                return None
+            oshp, odt = out_sig[slot]
+            if tuple(oshp) != tuple(shp) or str(odt) != str(dt):
+                return None
     in_refs = [(tag, v.slot if tag == "t" else st.add_ext(v, owner))
                for tag, v, owner in staged]
     rng_slot = st.add_ext(kw["rng"]) if "rng" in kw else None

@@ -36,7 +36,7 @@ from .. import autograd
 from .. import random_state
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
-__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "functionalize"]
 
 
 class _BlockScope(object):
@@ -592,3 +592,51 @@ class SymbolBlock(HybridBlock):
 
     def hybrid_forward(self, F, *args, **kwargs):  # pragma: no cover
         raise NotImplementedError
+
+
+def functionalize(block, *example_args, train=False):
+    """Extract the pure jittable forward of a HybridBlock.
+
+    Returns ``(fn, param_vals)`` where ``fn(param_vals, *input_vals)``
+    maps raw jax arrays to raw jax array outputs (a single array, or a
+    tuple when the block returns several).  This is the same
+    functionalized trace ``CachedOp`` compiles per signature — exposed
+    so callers can compose the forward into LARGER XLA programs
+    (``lax.scan`` chains for steady-state serving benchmarks, custom
+    pjit shardings, export pipelines) instead of paying one dispatch per
+    call.  ref: src/imperative/cached_op.cc — the reference's _CachedOp
+    handle plays this role for its graph executor.
+
+    ``example_args`` resolve deferred shapes with one eager pass;
+    ``train`` picks the training/inference trace (BatchNorm stats etc.).
+    Aux-state writes inside the trace (moving averages) are DISCARDED —
+    use the block's normal call path for stateful training.
+
+    RNG ops (dropout etc.) draw from the ``rng`` keyword — a jax PRNG
+    key that is part of the traced signature, exactly as in CachedOp's
+    compiled trace.  It defaults to a FIXED key: stochastic blocks must
+    pass a fresh ``rng=`` per call or every call reuses the same masks.
+    """
+    import jax as _jax
+    from ..ndarray import NDArray
+    from .. import autograd
+
+    block(*[NDArray(a) if not isinstance(a, NDArray) else a
+            for a in example_args])        # resolve deferred init
+    params = block.collect_params()
+    param_vals = {name: p.data()._read() for name, p in params.items()}
+
+    def fn(param_vals, *input_vals, rng=None):
+        if rng is None:
+            rng = _jax.random.PRNGKey(0)
+        shadows = {name: NDArray(v) for name, v in param_vals.items()}
+        nd_in = [NDArray(v) for v in input_vals]
+        with random_state.use_key(rng):
+            with autograd._scope(recording=False, training=train):
+                with block._trace_params(shadows):
+                    out = block.hybrid_forward_dispatch(*nd_in)
+        flat, _fmt = _flatten(out, "output")
+        vals = tuple(o._read() for o in flat)
+        return vals[0] if len(vals) == 1 else vals
+
+    return fn, param_vals

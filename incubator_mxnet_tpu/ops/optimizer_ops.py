@@ -32,6 +32,42 @@ def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradient=-
     return weight - lr * g
 
 
+@register("_sparse_sgd_update", num_inputs=3, differentiable=False,
+          mutate_inputs=(0,))
+def _sparse_sgd_update(weight, grad_data, grad_indices, lr=0.01, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    """Row-sparse lazy SGD: touch only the rows the gradient occupies
+    (ref: optimizer_op.cc SGDUpdateRspRspImpl).  Registered as an op —
+    not inline jnp in the optimizer — so ``engine.bulk`` can defer it
+    into a training segment like the reference's bulked updates."""
+    idx = grad_indices.astype(jnp.int32)
+    g = grad_data * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    rows = weight[idx]
+    g = g + wd * rows
+    return weight.at[idx].set(rows - lr * g)
+
+
+@register("_sparse_sgd_mom_update", num_inputs=4, differentiable=False,
+          mutate_inputs=(0, 3))
+def _sparse_sgd_mom_update(weight, grad_data, grad_indices, mom, lr=0.01,
+                           momentum=0.0, wd=0.0, rescale_grad=1.0,
+                           clip_gradient=-1.0):
+    """Row-sparse lazy SGD with momentum (ref: optimizer_op.cc
+    SGDMomUpdateRspRspImpl) — momentum state also updated only on the
+    occupied rows."""
+    idx = grad_indices.astype(jnp.int32)
+    g = grad_data * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    rows = weight[idx]
+    g = g + wd * rows
+    new_rows_m = momentum * mom[idx] - lr * g
+    return (weight.at[idx].set(rows + new_rows_m),
+            mom.at[idx].set(new_rows_m))
+
+
 @register("sgd_mom_update", num_inputs=3, differentiable=False, mutate_inputs=(0, 2))
 def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                     rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):

@@ -208,22 +208,19 @@ class SGD(Optimizer):
             invoke(get_op("sgd_update"), [weight, grad], kw, out=weight)
 
     def _sparse_sgd(self, weight, grad, state, kw):
-        import jax.numpy as jnp
-        idx = grad.indices._read().astype(jnp.int32)
-        g = grad.data._read() * kw["rescale_grad"]
-        clip = kw.get("clip_gradient", -1.0)
-        if clip and clip > 0:
-            g = jnp.clip(g, -clip, clip)
-        w = weight._read()
-        rows = w[idx]
-        g = g + kw["wd"] * rows
+        # registered ops (not inline jnp) so engine.bulk can defer the
+        # lazy update into a training segment — the reference bulks
+        # optimizer updates too (threaded_engine.h train segments)
+        ukw = {"lr": kw["lr"], "wd": kw["wd"],
+               "rescale_grad": kw["rescale_grad"],
+               "clip_gradient": kw.get("clip_gradient", -1.0)}
         if state is not None:
-            m = state._read()
-            new_rows_m = self.momentum * m[idx] - kw["lr"] * g
-            state._write(m.at[idx].set(new_rows_m))
-            weight._write(w.at[idx].set(rows + new_rows_m))
+            ukw["momentum"] = self.momentum
+            invoke(get_op("_sparse_sgd_mom_update"),
+                   [weight, grad.data, grad.indices, state], ukw, out=weight)
         else:
-            weight._write(w.at[idx].set(rows - kw["lr"] * g))
+            invoke(get_op("_sparse_sgd_update"),
+                   [weight, grad.data, grad.indices], ukw, out=weight)
 
     def update_multi_precision(self, index, weight, grad, state):
         use_mp = self.multi_precision and weight.dtype in (np.dtype("float16"),

@@ -90,8 +90,19 @@ def test_quantization_op_bench(capsys):
 def test_inference_score_bench(capsys):
     mod = _load("example/image-classification/benchmark_score.py",
                 "bench_score")
-    img_s = mod.score("squeezenet-1.0", batch_size=1, num_batches=2,
-                      dtype="float32")
+    img_s = mod.score_eager("squeezenet-1.0", batch_size=1, num_batches=2,
+                            dtype="float32")
+    assert img_s > 0
+
+
+def test_inference_score_steady_state():
+    """The chip-true mode: a 3-long scan chain through the functionalized
+    forward must run and yield a positive rate (mechanics only on CPU;
+    the real numbers come from the TPU sweep)."""
+    mod = _load("example/image-classification/benchmark_score.py",
+                "bench_score2")
+    img_s = mod.score_steady("squeezenet-1.0", batch_size=1, chain=3,
+                             repeats=1, dtype="float32")
     assert img_s > 0
 
 

@@ -102,3 +102,35 @@ def test_wire_bytes_beat_dense_for_all_worker_counts():
     for W in (2, 4, 8, 16, 32, 64, 128, 512, 1024):
         compressed, dense = wire_bytes_per_worker(n, W)
         assert compressed < dense, (W, compressed, dense)
+
+
+def test_compressed_wire_hlo_contains_intended_collectives():
+    """Pin the LOWERING the scale-correctness claim rides on (round-4
+    verdict #5): the compiled HLO of the jitted wire must contain (a) an
+    all-to-all on u32 — the packed 2-bit reduce-scatter — and (b) an
+    all-gather on s8 — the exact integer shard sums; and NO collective
+    may move f32 (a silent GSPMD re-lowering to a dense f32 all-reduce
+    would keep the numbers right while shipping 8x the bytes)."""
+    import re
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from incubator_mxnet_tpu.parallel import compression as C
+
+    W, n = 8, 100
+    mesh = Mesh(np.array(jax.devices("cpu")[:W]), ("worker",))
+    nw = C.packed_words(n)
+    k = -(-nw // W)
+    garr = jax.device_put(jnp.zeros((W, W * k), jnp.uint32),
+                          NamedSharding(mesh, P("worker")))
+    fn = C._rs_jitted(mesh, W, k, C._sum_code_dtype(W))
+    hlo = fn.lower(garr).compile().as_text()
+
+    a2a = re.findall(r"\bu32\[[\d,]*\][^\n]*\ball-to-all", hlo)
+    assert a2a, "no u32 all-to-all in compiled HLO:\n" + hlo[:2000]
+    ag = re.findall(r"\bs8\[[\d,]*\][^\n]*\ball-gather", hlo)
+    assert ag, "no s8 all-gather in compiled HLO:\n" + hlo[:2000]
+    f32_coll = re.findall(
+        r"\bf32\[[\d,]*\][^\n]*\b(all-reduce|all-gather|all-to-all)", hlo)
+    assert not f32_coll, "f32 collective leaked into the wire: %s" % f32_coll

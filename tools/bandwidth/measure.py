@@ -46,6 +46,21 @@ def main():
     p.add_argument("--platform", default=None,
                    help="'cpu' forces the CPU backend (multi-process CPU "
                         "runs: every worker must pick it BEFORE jax init)")
+    p.add_argument("--report", default="time", choices=["time", "bytes"],
+                   help="'bytes': report wire bytes shipped per round "
+                        "instead of loopback time.  On loopback transports "
+                        "encode/decode compute swamps free local bytes, so "
+                        "time CANNOT see the compression win "
+                        "(docs/bench_results_r04/README.md:97); bytes mode "
+                        "measures what the wire actually ships — the "
+                        "quantity the compressed wire optimizes.  The "
+                        "per-value byte model is wire_bytes_per_worker, "
+                        "whose lowering (u32 all-to-all + s8 all-gather) "
+                        "is pinned by an HLO assertion in "
+                        "tests/test_compression.py")
+    p.add_argument("--num-workers", type=int, default=0,
+                   help="bytes mode: model W workers without launching "
+                        "them (default: the live kv.num_workers)")
     args = p.parse_args()
 
     if args.platform == "cpu":
@@ -55,6 +70,33 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import nd
+
+    if args.report == "bytes":
+        from incubator_mxnet_tpu.parallel.compression import (
+            wire_bytes_per_worker)
+        sizes = default_sizes()
+        W = args.num_workers
+        if W <= 0:
+            kv = mx.kv.create(args.kv_store)
+            W = kv.num_workers
+        W = max(W, 2)      # a 1-worker "wire" ships nothing; model the
+        #                    smallest real topology and report that W
+        comp = dense = 0
+        for n in sizes:
+            c, d = wire_bytes_per_worker(n, W)
+            comp += c
+            dense += d
+        shipped = comp if args.gc_type != "none" else dense
+        print(json.dumps({
+            "metric": "kvstore_wire_bytes_per_round",
+            "kv_store": args.kv_store, "gc_type": args.gc_type,
+            "num_workers": W,
+            "payload_mb": round(4 * sum(sizes) / 1e6, 1),
+            "value": shipped, "unit": "bytes/worker/round",
+            "dense_bytes": dense, "compressed_bytes": comp,
+            "compression_ratio": round(dense / comp, 2),
+        }, ), flush=True)
+        return
 
     kv = mx.kv.create(args.kv_store)
     if args.gc_type != "none":
