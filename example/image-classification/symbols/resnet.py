@@ -1,0 +1,85 @@
+"""Symbolic ResNet family for the Module / quantization pipelines.
+
+Spec-driven builder (ref: example/image-classification/symbols/resnet.py
+— the reference's hand-unrolled per-depth functions become one plan
+table, the same style as the repo's Gluon zoo): post-activation v1
+residual units (conv-BN-relu), the variant whose conv+BN pairs fold
+cleanly for INT8 serving (contrib.quantization.fold_batchnorm).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "..")))
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+
+# depth -> (bottleneck?, units per stage); stage filters fixed per family
+SPECS = {
+    18: (False, (2, 2, 2, 2)),
+    34: (False, (3, 4, 6, 3)),
+    50: (True, (3, 4, 6, 3)),
+    101: (True, (3, 4, 23, 3)),
+    152: (True, (3, 8, 36, 3)),
+}
+
+
+def _conv_bn(data, num_filter, kernel, stride, pad, name, act=True):
+    c = mx.sym.Convolution(data, kernel=kernel, stride=stride, pad=pad,
+                           num_filter=num_filter, no_bias=True,
+                           name=name + "_conv")
+    b = mx.sym.BatchNorm(c, fix_gamma=False, eps=2e-5, momentum=0.9,
+                         name=name + "_bn")
+    return mx.sym.Activation(b, act_type="relu", name=name + "_relu") \
+        if act else b
+
+
+def residual_unit(data, num_filter, stride, dim_match, name, bottle_neck):
+    if bottle_neck:
+        mid = num_filter // 4
+        plan = [(mid, (1, 1), (1, 1), (0, 0)),
+                (mid, (3, 3), stride, (1, 1)),
+                (num_filter, (1, 1), (1, 1), (0, 0))]
+    else:
+        plan = [(num_filter, (3, 3), stride, (1, 1)),
+                (num_filter, (3, 3), (1, 1), (1, 1))]
+    x = data
+    for i, (f, k, st, pad) in enumerate(plan):
+        # the LAST conv-bn of the unit has no relu: activation follows
+        # the shortcut add (post-activation v1)
+        x = _conv_bn(x, f, k, st, pad, "%s_c%d" % (name, i + 1),
+                     act=(i + 1 < len(plan)))
+    if dim_match:
+        shortcut = data
+    else:
+        shortcut = _conv_bn(data, num_filter, (1, 1), stride, (0, 0),
+                            name + "_sc", act=False)
+    return mx.sym.Activation(x + shortcut, act_type="relu",
+                             name=name + "_out")
+
+
+def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
+               thumbnail=False, **kwargs):
+    """ResNet-v1 Symbol ending in SoftmaxOutput (drop it via
+    ``sym.get_internals()`` or take ``softmax`` off for serving)."""
+    bottle_neck, units = SPECS[num_layers]
+    filters = (256, 512, 1024, 2048) if bottle_neck else (64, 128, 256, 512)
+
+    data = mx.sym.var("data")
+    if thumbnail:
+        x = _conv_bn(data, 64, (3, 3), (1, 1), (1, 1), "stem")
+    else:
+        x = _conv_bn(data, 64, (7, 7), (2, 2), (3, 3), "stem")
+        x = mx.sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                           pool_type="max", name="stem_pool")
+    for s, (n_units, f) in enumerate(zip(units, filters)):
+        for u in range(n_units):
+            stride = (1, 1) if (s == 0 or u > 0) else (2, 2)
+            x = residual_unit(x, f, stride, dim_match=(u > 0),
+                              name="stage%d_unit%d" % (s + 1, u + 1),
+                              bottle_neck=bottle_neck)
+    x = mx.sym.Pooling(x, global_pool=True, pool_type="avg", kernel=(7, 7),
+                       name="pool_final")
+    x = mx.sym.Flatten(x, name="flat")
+    x = mx.sym.FullyConnected(x, num_hidden=num_classes, name="fc1")
+    return mx.sym.SoftmaxOutput(x, name="softmax")

@@ -41,7 +41,129 @@ from ..io import DataIter
 __all__ = ["quantize_symbol", "quantize_params", "set_calib_table",
            "quantize_model", "collect_layer_output_min_max",
            "collect_layer_outputs", "get_optimal_threshold",
-           "get_optimal_thresholds"]
+           "get_optimal_thresholds", "fold_batchnorm"]
+
+
+def fold_batchnorm(sym, arg_params, aux_params):
+    """Fold inference-mode BatchNorm into its preceding Convolution.
+
+    ``BN(conv(x, W) + b)`` with moving statistics is an affine map per
+    output channel, so for serving the pair collapses to one convolution
+    with scaled weights and a shifted bias:
+
+        s  = gamma / sqrt(moving_var + eps)
+        W' = W * s          (per output channel)
+        b' = (b - moving_mean) * s + beta
+
+    Run this BEFORE :func:`quantize_model`: without it every conv's int8
+    output must be dequantized to f32 just to feed a BatchNorm, and the
+    dequant/requant churn eats the MXU win.  The reference reaches the
+    same state through its fused MKLDNN conv-BN subgraphs
+    (ref: quantize_graph_pass.cc + subgraph fusion); here it is an
+    explicit graph pass because XLA has no post-hoc fusion across the
+    int8 boundary.
+
+    Only folds when the conv's sole consumer is the BatchNorm and all
+    five BN inputs (and the conv weight) are plain parameter variables.
+    The returned symbol is INFERENCE-ONLY (training would need the batch
+    statistics back).  Returns ``(folded_sym, arg_params, aux_params)``
+    — new dicts, inputs untouched.
+    """
+    args = dict(arg_params)
+    aux = dict(aux_params)
+    topo = sym._topo()
+    consumers = {}
+    for node in topo:
+        if node.is_variable():
+            continue
+        for e in node._inputs:
+            b = e._base()
+            consumers[id(b)] = consumers.get(id(b), 0) + 1
+    for r in sym._roots():
+        # an output root has an external consumer: never fold it away
+        consumers[id(r._base())] = consumers.get(id(r._base()), 0) + 1
+
+    rebuilt = {}
+
+    def look(e):
+        b = e._base()
+        n = rebuilt[id(b)]
+        if e._out_index is not None and n._num_outputs > 1:
+            return n[e._out_index]
+        return n
+
+    for node in topo:
+        if node.is_variable():
+            rebuilt[id(node)] = node
+            continue
+        if (node._op is not None and node._op.name == "BatchNorm"
+                and not node._params.get("output_mean_var")):
+            src = node._inputs[0]._base()
+            src_idx = node._inputs[0]._out_index or 0
+            # preconditions: channel-axis BN over a single-consumer conv,
+            # and every folded parameter variable used NOWHERE else — a
+            # shared weight would be rescaled once per fold and read by
+            # convs needing different scales (review finding, round 5);
+            # axis != 1 scales the wrong weight dimension
+            fold = (not src.is_variable() and src._op is not None
+                    and src._op.name == "Convolution"
+                    and int(node._params.get("axis", 1)) == 1
+                    and consumers.get(id(src), 0) == 1 and src_idx == 0
+                    and all(e._base().is_variable()
+                            and consumers.get(id(e._base()), 0) == 1
+                            for e in list(node._inputs[1:5])
+                            + list(src._inputs[1:])))
+            if fold:
+                wname = src._inputs[1]._base().name
+                gname, bname, mname, vname = (
+                    e._base().name for e in node._inputs[1:5])
+                fold = (wname in args and gname in args and bname in args
+                        and mname in aux and vname in aux)
+            if fold:
+                eps = float(node._params.get("eps", 1e-3))
+                W = args[wname].asnumpy()
+                gamma = (np.ones(W.shape[0], np.float32)
+                         if node._params.get("fix_gamma", True)
+                         else args[gname].asnumpy())
+                s = gamma / np.sqrt(aux[vname].asnumpy() + eps)
+                if src._params.get("no_bias", False):
+                    b0 = np.zeros(W.shape[0], np.float32)
+                    bias_sym = var((src._name or "conv") + "_folded_bias")
+                else:
+                    bias_sym = src._inputs[2]._base()
+                    b0 = args[bias_sym.name].asnumpy()
+                args[wname] = ndarray.array(
+                    W * s.reshape((-1,) + (1,) * (W.ndim - 1)))
+                args[bias_sym.name] = ndarray.array(
+                    (b0 - aux[mname].asnumpy()) * s
+                    + args[bname].asnumpy())
+                args.pop(gname, None)
+                args.pop(bname, None)
+                aux.pop(mname, None)
+                aux.pop(vname, None)
+                new_params = dict(src._params)
+                new_params["no_bias"] = False
+                folded = Symbol(src._op,
+                                [look(src._inputs[0]),
+                                 src._inputs[1]._base(), bias_sym],
+                                new_params, src._name, src._num_outputs,
+                                attrs=dict(src._attr))
+                rebuilt[id(src)] = folded
+                rebuilt[id(node)] = folded
+                continue
+        rebuilt[id(node)] = Symbol(
+            node._op, [look(e) for e in node._inputs], dict(node._params),
+            node._name, node._num_outputs, attrs=dict(node._attr))
+
+    new_roots = []
+    for r in sym._roots():
+        b = r._base()
+        n = rebuilt[id(b)]
+        if r._out_index is not None and n._num_outputs > 1:
+            n = n[r._out_index]
+        new_roots.append(n)
+    out = new_roots[0] if len(new_roots) == 1 else Group(new_roots)
+    return out, args, aux
 
 
 def _accepted_params(op, params):

@@ -378,9 +378,15 @@ def flush(state=None):
     st.any_recorded = False
     st.epoch += 1
 
-    # only values still exposed through a live NDArray leave the program
+    # only values still EXPOSED through a live NDArray leave the program:
+    # the owner must not just be alive, its buffer must still be this
+    # pending — a chained out= store rebinds the owner to each successive
+    # pending, and without the `_data is p` check every superseded
+    # intermediate would escape the program as a dead output (review
+    # finding, round 5: N-long update chains shipped N-1 dead buffers)
     live = tuple(i for i, p in enumerate(pendings)
-                 if any(w() is not None for w in p.owners))
+                 if any(o is not None and o._data is p
+                        for o in (w() for w in p.owners)))
     key = (tuple((name, pkey, train, in_refs, rng_slot, n_out, rec)
                  for name, _p, pkey, train, in_refs, rng_slot, n_out, rec
                  in instrs),

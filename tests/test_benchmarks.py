@@ -111,3 +111,31 @@ def test_transformer_bench_flops_model():
     # 6*N*T + L * 6*S*T*d (attention term is per layer)
     got = mod.model_flops_per_step(100, 10, 4, 8, n_layers=3)
     assert got == 6 * 100 * 10 + 3 * 6 * 4 * 10 * 8
+
+
+def test_quantized_inference_bench_mechanics(monkeypatch):
+    """The INT8 serving bench (fold -> calibrate -> quantize -> chained
+    steady timing) runs end-to-end on a thumbnail resnet-18 and reports
+    a positive speedup field (mechanics only on CPU; the committed ratio
+    comes from the TPU run)."""
+    import sys
+    monkeypatch.setattr(sys, "argv", [
+        "x", "--num-layers", "18", "--image-size", "32", "--batch-size",
+        "2", "--chain", "2", "--num-calib-batches", "1",
+        "--calib-batch-size", "4"])
+    mod = _load("example/quantization/imagenet_inference.py", "bench_qinf")
+    mod.main()
+
+
+def test_symbolic_resnet_shapes():
+    """The spec-driven symbolic ResNet family infers the canonical
+    feature shapes at every depth (ref example/image-classification/
+    symbols/resnet.py depth table)."""
+    mod = _load("example/image-classification/symbols/resnet.py",
+                "sym_resnet")
+    for depth in (18, 34, 50, 101, 152):
+        sym = mod.get_symbol(num_classes=10, num_layers=depth)
+        pred = sym.get_internals()["fc1_output"]
+        shapes, _, _ = pred.infer_shape(data=(1, 3, 224, 224))
+        out = dict(zip(pred.list_arguments(), shapes))
+        assert out["fc1_weight"][1] == (2048 if depth >= 50 else 512)

@@ -365,3 +365,25 @@ def test_bulk_lazy_sparse_sgd_defers():
     # untouched rows really untouched
     np.testing.assert_array_equal(we[0], w0[0])
     assert not np.allclose(we[2], w0[2])
+
+
+def test_bulk_chained_store_dead_intermediates_eliminated():
+    """A chain of out= stores rebinds the target N times; only the LAST
+    pending is exposed, so the compiled replay must return exactly one
+    value (review finding: superseded intermediates escaped as dead
+    outputs, shipping N-1 weight-sized buffers per flush)."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import engine
+
+    w = mx.nd.array(np.ones((8,), np.float32))
+    g = mx.nd.array(np.full((8,), 0.5, np.float32))
+    before = set(engine._replay_cache)
+    with mx.engine.bulk(16):
+        for _ in range(4):
+            mx.nd.sgd_update(w, g, lr=0.1, wd=0.0, out=w)
+    new_keys = [k for k in engine._replay_cache if k not in before]
+    assert len(new_keys) == 1
+    live = new_keys[0][-1]
+    assert len(live) == 1, "dead intermediate outputs shipped: %r" % (live,)
+    np.testing.assert_allclose(w.asnumpy(), 1.0 - 4 * 0.05, rtol=1e-6)

@@ -246,3 +246,104 @@ def test_quantized_model_via_module():
     ref = net.bind(mx.cpu(), args, grad_req="null") \
              .forward(is_train=False)[0].asnumpy()
     assert (got.argmax(1) == ref.argmax(1)).all()
+
+
+def test_fold_batchnorm_preserves_inference():
+    """conv+BN folds to one conv with scaled weights/shifted bias; the
+    folded graph must reproduce the unfolded inference output and drop
+    the BN params, leaving a quantization-friendly conv chain."""
+    rng = np.random.RandomState(0)
+    data = sym.var("data")
+    c = sym.Convolution(data, kernel=(3, 3), num_filter=6, pad=(1, 1),
+                        no_bias=True, name="convA")
+    b = sym.BatchNorm(c, fix_gamma=False, eps=1e-3, name="bnA")
+    r = sym.Activation(b, act_type="relu", name="reluA")
+    c2 = sym.Convolution(r, kernel=(1, 1), num_filter=4, name="convB")
+    b2 = sym.BatchNorm(c2, fix_gamma=True, eps=1e-3, name="bnB")
+    net = sym.Flatten(b2, name="flat")
+
+    args = {
+        "convA_weight": nd.array(rng.randn(6, 3, 3, 3).astype(np.float32)),
+        "bnA_gamma": nd.array(rng.rand(6).astype(np.float32) + 0.5),
+        "bnA_beta": nd.array(rng.randn(6).astype(np.float32)),
+        "convB_weight": nd.array(rng.randn(4, 6, 1, 1).astype(np.float32)),
+        "convB_bias": nd.array(rng.randn(4).astype(np.float32)),
+        "bnB_gamma": nd.array(rng.rand(4).astype(np.float32) + 0.5),
+        "bnB_beta": nd.array(rng.randn(4).astype(np.float32)),
+    }
+    aux = {
+        "bnA_moving_mean": nd.array(rng.randn(6).astype(np.float32)),
+        "bnA_moving_var": nd.array(rng.rand(6).astype(np.float32) + 0.5),
+        "bnB_moving_mean": nd.array(rng.randn(4).astype(np.float32)),
+        "bnB_moving_var": nd.array(rng.rand(4).astype(np.float32) + 0.5),
+    }
+    x = nd.array(rng.randn(2, 3, 8, 8).astype(np.float32))
+
+    ref = net.bind(mx.cpu(), {**args, "data": x}, aux_states=aux,
+                   grad_req="null").forward(is_train=False)[0].asnumpy()
+
+    fsym, fargs, faux = qz.fold_batchnorm(net, args, aux)
+    assert not faux, "all BN stats should fold away"
+    assert not any("gamma" in k or "beta" in k for k in fargs)
+    op_names = [n._op.name for n in fsym._topo() if not n.is_variable()]
+    assert "BatchNorm" not in op_names
+    got = fsym.bind(mx.cpu(), {**fargs, "data": x},
+                    grad_req="null").forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    # folded graph quantizes end-to-end and stays close
+    qsym, qargs, _ = qz.quantize_model(
+        fsym, fargs, {}, calib_mode="naive",
+        calib_data=io.NDArrayIter(data=x.asnumpy(), batch_size=2),
+        num_calib_examples=2)
+    qout = qsym.bind(mx.cpu(), {**qargs, "data": x},
+                     grad_req="null").forward(is_train=False)[0].asnumpy()
+    # int8 tolerance: relative to the output scale
+    assert np.abs(qout - ref).max() / (np.abs(ref).max() + 1e-9) < 0.1
+
+
+def test_fold_batchnorm_refuses_unsafe_patterns():
+    """Shared parameter variables and non-channel axis must NOT fold
+    (review findings: a shared weight would be double-rescaled; axis!=1
+    scales the wrong weight dimension)."""
+    rng = np.random.RandomState(1)
+    # shared weight feeding two conv+BN pairs
+    data = sym.var("data")
+    w = sym.var("shared_weight")
+    c1 = sym.Convolution(data, w, kernel=(1, 1), num_filter=4, no_bias=True,
+                         name="convS1")
+    b1 = sym.BatchNorm(c1, fix_gamma=True, name="bnS1")
+    c2 = sym.Convolution(data, w, kernel=(1, 1), num_filter=4, no_bias=True,
+                         name="convS2")
+    b2 = sym.BatchNorm(c2, fix_gamma=True, name="bnS2")
+    net = b1 + b2
+    args = {"shared_weight": nd.array(rng.randn(4, 3, 1, 1)
+                                      .astype(np.float32)),
+            "bnS1_gamma": nd.ones((4,)), "bnS1_beta": nd.zeros((4,)),
+            "bnS2_gamma": nd.ones((4,)), "bnS2_beta": nd.zeros((4,))}
+    aux = {"bnS1_moving_mean": nd.array(rng.randn(4).astype(np.float32)),
+           "bnS1_moving_var": nd.array(rng.rand(4).astype(np.float32) + .5),
+           "bnS2_moving_mean": nd.array(rng.randn(4).astype(np.float32)),
+           "bnS2_moving_var": nd.array(rng.rand(4).astype(np.float32) + .5)}
+    x = nd.array(rng.randn(2, 3, 5, 5).astype(np.float32))
+    ref = net.bind(mx.cpu(), {**args, "data": x}, aux_states=aux,
+                   grad_req="null").forward(is_train=False)[0].asnumpy()
+    fsym, fargs, faux = qz.fold_batchnorm(net, args, aux)
+    ops = [n._op.name for n in fsym._topo() if not n.is_variable()]
+    assert ops.count("BatchNorm") == 2, "shared weight must refuse to fold"
+    got = fsym.bind(mx.cpu(), {**fargs, "data": x}, aux_states=faux,
+                    grad_req="null").forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    # axis != 1: refuse
+    c3 = sym.Convolution(data, kernel=(1, 1), num_filter=5, no_bias=True,
+                         name="convAx")
+    b3 = sym.BatchNorm(c3, axis=3, fix_gamma=True, name="bnAx")
+    args3 = {"convAx_weight": nd.array(rng.randn(5, 3, 1, 1)
+                                       .astype(np.float32)),
+             "bnAx_gamma": nd.ones((5,)), "bnAx_beta": nd.zeros((5,))}
+    aux3 = {"bnAx_moving_mean": nd.array(rng.randn(5).astype(np.float32)),
+            "bnAx_moving_var": nd.array(rng.rand(5).astype(np.float32) + .5)}
+    fsym3, _, faux3 = qz.fold_batchnorm(b3, args3, aux3)
+    ops3 = [n._op.name for n in fsym3._topo() if not n.is_variable()]
+    assert "BatchNorm" in ops3 and faux3, "axis!=1 must refuse to fold"
