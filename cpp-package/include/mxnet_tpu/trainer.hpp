@@ -30,6 +30,18 @@ int MXTrainerGetOutputShape(void*, uint32_t, uint32_t**, uint32_t*);
 int MXTrainerGetOutput(void*, uint32_t, float*, uint32_t);
 int MXTrainerSaveParams(void*, const char**, uint64_t*);
 int MXTrainerFree(void*);
+int MXDataIterCreate(const char*, const char*, void**);
+int MXDataIterNext(void*, int*);
+int MXDataIterReset(void*);
+int MXDataIterGetData(void*, const float**, const uint32_t**, uint32_t*);
+int MXDataIterGetLabel(void*, const float**, const uint32_t**, uint32_t*);
+int MXDataIterFree(void*);
+int MXMetricCreate(const char*, void**);
+int MXMetricUpdate(void*, const float*, const uint32_t*, uint32_t,
+                   const float*, const uint32_t*, uint32_t);
+int MXMetricGet(void*, float*);
+int MXMetricReset(void*);
+int MXMetricFree(void*);
 const char* MXTrainGetLastError();
 }
 
@@ -107,6 +119,94 @@ class Trainer {
     Check(MXTrainerSaveParams(handle_, &bytes, &size));
     return std::string(bytes, static_cast<size_t>(size));
   }
+
+ private:
+  static void Check(int rc) {
+    if (rc != 0) throw std::runtime_error(MXTrainGetLastError());
+  }
+  void* handle_ = nullptr;
+};
+
+// One batch as returned by DataIter::GetData/GetLabel — values are a
+// COPY (the ABI's shared buffer is only valid until the next fetch).
+struct Batch {
+  std::vector<float> values;
+  std::vector<uint32_t> shape;
+  size_t size() const { return values.size(); }
+};
+
+// Data iterator by registered name + JSON kwargs (the reference's
+// MXDataIterCreateIter family): ImageRecordIter / CSVIter / MNISTIter /
+// LibSVMIter.
+class DataIter {
+ public:
+  DataIter(const std::string& name, const std::string& params_json) {
+    if (MXDataIterCreate(name.c_str(), params_json.c_str(), &handle_) != 0) {
+      throw std::runtime_error(MXTrainGetLastError());
+    }
+  }
+  ~DataIter() {
+    if (handle_) MXDataIterFree(handle_);
+  }
+  DataIter(const DataIter&) = delete;
+  DataIter& operator=(const DataIter&) = delete;
+
+  bool Next() {
+    int has = 0;
+    Check(MXDataIterNext(handle_, &has));
+    return has != 0;
+  }
+  void Reset() { Check(MXDataIterReset(handle_)); }
+
+  Batch GetData() { return Fetch(&MXDataIterGetData); }
+  Batch GetLabel() { return Fetch(&MXDataIterGetLabel); }
+
+ private:
+  using FetchFn = int (*)(void*, const float**, const uint32_t**, uint32_t*);
+  Batch Fetch(FetchFn fn) {
+    const float* data = nullptr;
+    const uint32_t* shape = nullptr;
+    uint32_t ndim = 0;
+    Check(fn(handle_, &data, &shape, &ndim));
+    Batch b;
+    b.shape.assign(shape, shape + ndim);
+    size_t n = 1;
+    for (uint32_t d : b.shape) n *= d;
+    b.values.assign(data, data + n);
+    return b;
+  }
+  static void Check(int rc) {
+    if (rc != 0) throw std::runtime_error(MXTrainGetLastError());
+  }
+  void* handle_ = nullptr;
+};
+
+// Eval metric by registry name ("accuracy", "top_k_accuracy", "mse", ...).
+class Metric {
+ public:
+  explicit Metric(const std::string& name) {
+    if (MXMetricCreate(name.c_str(), &handle_) != 0) {
+      throw std::runtime_error(MXTrainGetLastError());
+    }
+  }
+  ~Metric() {
+    if (handle_) MXMetricFree(handle_);
+  }
+  Metric(const Metric&) = delete;
+  Metric& operator=(const Metric&) = delete;
+
+  void Update(const Batch& label, const Batch& pred) {
+    Check(MXMetricUpdate(handle_, label.values.data(), label.shape.data(),
+                         static_cast<uint32_t>(label.shape.size()),
+                         pred.values.data(), pred.shape.data(),
+                         static_cast<uint32_t>(pred.shape.size())));
+  }
+  float Get() {
+    float v = 0.f;
+    Check(MXMetricGet(handle_, &v));
+    return v;
+  }
+  void Reset() { Check(MXMetricReset(handle_)); }
 
  private:
   static void Check(int rc) {

@@ -143,3 +143,95 @@ def create_trainer(symbol_json, input_shapes, optimizer, optimizer_params,
     return CTrainer(symbol_json, input_shapes, optimizer=optimizer,
                     optimizer_params=optimizer_params or None,
                     param_bytes=param_bytes or None)
+
+
+class CDataIter(object):
+    """One data iterator driven through the C ABI (the role of the
+    reference's MXDataIterCreateIter/MXDataIterNext C API family,
+    c_api.cc — here over the Python io registry, same layering as
+    CTrainer)."""
+
+    def __init__(self, it):
+        self._it = it
+        self._batch = None
+        self._cache = {}
+
+    def next(self):
+        self._cache.clear()
+        try:
+            self._batch = next(self._it)
+            return 1
+        except StopIteration:
+            self._batch = None
+            return 0
+
+    def reset(self):
+        self._cache.clear()
+        self._it.reset()
+
+    def _arr(self, which, index):
+        # the C ABI fetches bytes then shape per batch part: cache the
+        # converted array so each part materializes once per batch
+        key = (which, index)
+        got = self._cache.get(key)
+        if got is None:
+            arrs = self._batch.data if which == "data" \
+                else self._batch.label
+            got = arrs[index].asnumpy().astype(np.float32)
+            self._cache[key] = got
+        return got
+
+    def data_bytes(self, index=0):
+        return self._arr("data", index).tobytes()
+
+    def label_bytes(self, index=0):
+        return self._arr("label", index).tobytes()
+
+    def data_shape(self, index=0):
+        return tuple(int(d) for d in self._arr("data", index).shape)
+
+    def label_shape(self, index=0):
+        return tuple(int(d) for d in self._arr("label", index).shape)
+
+
+_C_ITER_FACTORIES = ("ImageRecordIter", "CSVIter", "MNISTIter",
+                     "LibSVMIter")
+
+
+def create_data_iter(name, params_json):
+    """Factory by registered iterator name + JSON kwargs — the C ABI's
+    MXDataIterCreate.  JSON lists become tuples (shape arguments)."""
+    from . import io as mio
+    if name not in _C_ITER_FACTORIES:
+        raise ValueError("unknown data iter %r (have %s)"
+                         % (name, ", ".join(_C_ITER_FACTORIES)))
+    kwargs = json.loads(params_json) if params_json else {}
+    kwargs = {k: tuple(v) if isinstance(v, list) else v
+              for k, v in kwargs.items()}
+    return CDataIter(getattr(mio, name)(**kwargs))
+
+
+class CMetric(object):
+    """One EvalMetric driven through the C ABI (MXMetric*)."""
+
+    def __init__(self, name):
+        from . import metric as metric_mod
+        self._m = metric_mod.create(name)
+
+    def update(self, label_bytes, label_shape, pred_bytes, pred_shape):
+        from . import ndarray as nd
+        label = np.frombuffer(label_bytes, np.float32).reshape(
+            tuple(label_shape))
+        pred = np.frombuffer(pred_bytes, np.float32).reshape(
+            tuple(pred_shape))
+        self._m.update([nd.array(label)], [nd.array(pred)])
+
+    def get(self):
+        return float(self._m.get()[1])
+
+    def reset(self):
+        self._m.reset()
+
+
+def create_metric(name):
+    return CMetric(name)

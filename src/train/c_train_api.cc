@@ -249,4 +249,210 @@ int MXTrainerFree(void* handle) {
   return 0;
 }
 
+// ---------------------------------------------------------------------------
+// Data iterators (the reference's MXDataIterCreateIter/Next/GetData/GetLabel
+// C API family, src/c_api/c_api.cc — over the Python io registry).
+// ---------------------------------------------------------------------------
+
+int MXDataIterCreate(const char* name, const char* params_json, void** out) {
+  ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* mod = PyImport_ImportModule("incubator_mxnet_tpu.train_api");
+  if (mod) {
+    PyObject* res = PyObject_CallMethod(mod, "create_data_iter", "ss", name,
+                                        params_json ? params_json : "{}");
+    if (res) {
+      auto* t = new Trainer();
+      t->obj = res;
+      *out = t;
+      rc = 0;
+    } else {
+      set_err(fetch_py_error());
+    }
+    Py_DECREF(mod);
+  } else {
+    set_err(fetch_py_error());
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// *out_has_next = 1 and the batch is staged, or 0 at epoch end.
+int MXDataIterNext(void* handle, int* out_has_next) {
+  auto* t = static_cast<Trainer*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* res = PyObject_CallMethod(t->obj, "next", nullptr);
+  int rc = -1;
+  if (res) {
+    *out_has_next = static_cast<int>(PyLong_AsLong(res));
+    Py_DECREF(res);
+    rc = 0;
+  } else {
+    set_err(fetch_py_error());
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXDataIterReset(void* handle) {
+  auto* t = static_cast<Trainer*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* res = PyObject_CallMethod(t->obj, "reset", nullptr);
+  int rc = res ? 0 : -1;
+  if (!res) set_err(fetch_py_error());
+  Py_XDECREF(res);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+namespace {
+
+// fetch "<which>_bytes" into the shared blob + "<which>_shape" into the
+// shared shape buffer; pointers stay valid until the next fetch on any
+// iterator (single-reader convention, same as MXTrainerSaveParams)
+int fetch_batch_part(Trainer* t, const char* which, const float** out_data,
+                     const uint32_t** out_shape, uint32_t* out_ndim) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  std::string meth = std::string(which) + "_bytes";
+  PyObject* res = PyObject_CallMethod(t->obj, meth.c_str(), nullptr);
+  if (!res) {
+    set_err(fetch_py_error());
+    PyGILState_Release(gil);
+    return -1;
+  }
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  PyBytes_AsStringAndSize(res, &buf, &len);
+  static thread_local std::string data_buf;
+  data_buf.assign(buf, static_cast<size_t>(len));
+  Py_DECREF(res);
+
+  std::string smeth = std::string(which) + "_shape";
+  PyObject* shp = PyObject_CallMethod(t->obj, smeth.c_str(), nullptr);
+  if (!shp) {
+    set_err(fetch_py_error());
+    PyGILState_Release(gil);
+    return -1;
+  }
+  Py_ssize_t n = PyTuple_Size(shp);
+  static thread_local std::vector<uint32_t> shape_buf;
+  shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    shape_buf[i] = static_cast<uint32_t>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(shp, i)));
+  }
+  Py_DECREF(shp);
+  *out_data = reinterpret_cast<const float*>(data_buf.data());
+  *out_shape = shape_buf.data();
+  *out_ndim = static_cast<uint32_t>(n);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+}  // namespace
+
+int MXDataIterGetData(void* handle, const float** out_data,
+                      const uint32_t** out_shape, uint32_t* out_ndim) {
+  return fetch_batch_part(static_cast<Trainer*>(handle), "data", out_data,
+                          out_shape, out_ndim);
+}
+
+int MXDataIterGetLabel(void* handle, const float** out_data,
+                       const uint32_t** out_shape, uint32_t* out_ndim) {
+  return fetch_batch_part(static_cast<Trainer*>(handle), "label", out_data,
+                          out_shape, out_ndim);
+}
+
+int MXDataIterFree(void* handle) { return MXTrainerFree(handle); }
+
+// ---------------------------------------------------------------------------
+// Eval metrics (the registry the Python fit loop uses, by name).
+// ---------------------------------------------------------------------------
+
+int MXMetricCreate(const char* name, void** out) {
+  ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* mod = PyImport_ImportModule("incubator_mxnet_tpu.train_api");
+  if (mod) {
+    PyObject* res = PyObject_CallMethod(mod, "create_metric", "s", name);
+    if (res) {
+      auto* t = new Trainer();
+      t->obj = res;
+      *out = t;
+      rc = 0;
+    } else {
+      set_err(fetch_py_error());
+    }
+    Py_DECREF(mod);
+  } else {
+    set_err(fetch_py_error());
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXMetricUpdate(void* handle, const float* label, const uint32_t* lshape,
+                   uint32_t lndim, const float* pred, const uint32_t* pshape,
+                   uint32_t pndim) {
+  auto* t = static_cast<Trainer*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  size_t ln = 1, pn = 1;
+  PyObject* lsh = PyTuple_New(lndim);
+  for (uint32_t i = 0; i < lndim; ++i) {
+    ln *= lshape[i];
+    PyTuple_SetItem(lsh, i, PyLong_FromUnsignedLong(lshape[i]));
+  }
+  PyObject* psh = PyTuple_New(pndim);
+  for (uint32_t i = 0; i < pndim; ++i) {
+    pn *= pshape[i];
+    PyTuple_SetItem(psh, i, PyLong_FromUnsignedLong(pshape[i]));
+  }
+  PyObject* lb = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(label), ln * sizeof(float));
+  PyObject* pb = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(pred), pn * sizeof(float));
+  PyObject* res = PyObject_CallMethod(t->obj, "update", "OOOO", lb, lsh, pb,
+                                      psh);
+  int rc = res ? 0 : -1;
+  if (!res) set_err(fetch_py_error());
+  Py_XDECREF(res);
+  Py_DECREF(lb);
+  Py_DECREF(pb);
+  Py_DECREF(lsh);
+  Py_DECREF(psh);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXMetricGet(void* handle, float* out_value) {
+  auto* t = static_cast<Trainer*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* res = PyObject_CallMethod(t->obj, "get", nullptr);
+  int rc = -1;
+  if (res) {
+    *out_value = static_cast<float>(PyFloat_AsDouble(res));
+    Py_DECREF(res);
+    rc = 0;
+  } else {
+    set_err(fetch_py_error());
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXMetricReset(void* handle) {
+  auto* t = static_cast<Trainer*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* res = PyObject_CallMethod(t->obj, "reset", nullptr);
+  int rc = res ? 0 : -1;
+  if (!res) set_err(fetch_py_error());
+  Py_XDECREF(res);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXMetricFree(void* handle) { return MXTrainerFree(handle); }
+
 }  // extern "C"

@@ -113,11 +113,12 @@ def test_transformer_bench_flops_model():
     assert got == 6 * 100 * 10 + 3 * 6 * 4 * 10 * 8
 
 
-def test_quantized_inference_bench_mechanics(monkeypatch):
+def test_quantized_inference_bench_mechanics(monkeypatch, capsys):
     """The INT8 serving bench (fold -> calibrate -> quantize -> chained
     steady timing) runs end-to-end on a thumbnail resnet-18 and reports
     a positive speedup field (mechanics only on CPU; the committed ratio
     comes from the TPU run)."""
+    import json as _json
     import sys
     monkeypatch.setattr(sys, "argv", [
         "x", "--num-layers", "18", "--image-size", "32", "--batch-size",
@@ -125,6 +126,12 @@ def test_quantized_inference_bench_mechanics(monkeypatch):
         "--calib-batch-size", "4"])
     mod = _load("example/quantization/imagenet_inference.py", "bench_qinf")
     mod.main()
+    line = [l for l in capsys.readouterr().out.splitlines()
+            if l.startswith("{")][-1]
+    row = _json.loads(line)
+    assert row["int8_speedup_vs_bf16"] > 0
+    assert row["bf16_imgs_per_sec"] > 0 and row["int8_imgs_per_sec"] > 0
+    assert 0.0 <= row["top1_agreement_int8_vs_f32"] <= 1.0
 
 
 def test_symbolic_resnet_shapes():

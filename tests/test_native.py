@@ -217,3 +217,106 @@ def test_c_train_api_in_process(tmp_path):
                 is_train=False)
     pred = mod.get_outputs()[0].asnumpy().argmax(1)
     assert (pred == y).mean() > 0.9, (pred == y).mean()
+
+
+def test_c_data_iter_and_metric_abi(tmp_path):
+    """MXDataIter* + MXMetric* ABIs via ctypes: write a raw .rec from
+    Python, iterate it through the C handle, and score a perfect
+    prediction set with the registry accuracy metric."""
+    lib_path = os.path.join(os.path.dirname(__file__), "..", "src",
+                            "build", "libmxtpu_train.so")
+    if not os.path.exists(lib_path):
+        pytest.skip("train lib not built")
+    lib = ctypes.CDLL(lib_path)
+    lib.MXTrainGetLastError.restype = ctypes.c_char_p
+
+    # 8 records of 1x4x4 raw uint8, labels alternate 0/1
+    rec = str(tmp_path / "it.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        img = rng.randint(0, 4, (4, 4, 1), dtype=np.uint8)
+        w.write(recordio.pack(recordio.IRHeader(0, float(i % 2), i, 0),
+                              img.tobytes()))
+    w.close()
+
+    params = ('{"path_imgrec": "%s", "data_shape": [1, 4, 4], '
+              '"batch_size": 4, "label_width": 1, "decode": "raw", '
+              '"prefetch_buffer": 0}' % rec)
+    h = ctypes.c_void_p()
+    rc = lib.MXDataIterCreate(b"ImageRecordIter", params.encode(),
+                              ctypes.byref(h))
+    assert rc == 0, lib.MXTrainGetLastError()
+
+    fptr = ctypes.POINTER(ctypes.c_float)
+    uptr = ctypes.POINTER(ctypes.c_uint32)
+    data_p, shape_p = fptr(), uptr()
+    ndim = ctypes.c_uint32()
+    has = ctypes.c_int()
+    seen_labels = []
+    batches = 0
+    while True:
+        assert lib.MXDataIterNext(h, ctypes.byref(has)) == 0
+        if not has.value:
+            break
+        batches += 1
+        assert lib.MXDataIterGetData(h, ctypes.byref(data_p),
+                                     ctypes.byref(shape_p),
+                                     ctypes.byref(ndim)) == 0
+        shape = tuple(shape_p[i] for i in range(ndim.value))
+        assert shape == (4, 1, 4, 4)
+        assert lib.MXDataIterGetLabel(h, ctypes.byref(data_p),
+                                      ctypes.byref(shape_p),
+                                      ctypes.byref(ndim)) == 0
+        n = 1
+        for i in range(ndim.value):
+            n *= shape_p[i]
+        seen_labels.extend(data_p[i] for i in range(n))
+    assert batches == 2
+    assert sorted(set(seen_labels)) == [0.0, 1.0]
+    # reset replays the epoch
+    assert lib.MXDataIterReset(h) == 0
+    assert lib.MXDataIterNext(h, ctypes.byref(has)) == 0 and has.value
+    lib.MXDataIterFree(h)
+
+    # metric: 3/4 correct predictions -> 0.75
+    m = ctypes.c_void_p()
+    assert lib.MXMetricCreate(b"accuracy", ctypes.byref(m)) == 0, \
+        lib.MXTrainGetLastError()
+    labels = np.array([0, 1, 0, 1], np.float32)
+    preds = np.array([[.9, .1], [.2, .8], [.3, .7], [.1, .9]], np.float32)
+    lshape = (ctypes.c_uint32 * 1)(4)
+    pshape = (ctypes.c_uint32 * 2)(4, 2)
+    assert lib.MXMetricUpdate(
+        m, labels.ctypes.data_as(fptr), lshape, 1,
+        preds.ctypes.data_as(fptr), pshape, 2) == 0
+    val = ctypes.c_float()
+    assert lib.MXMetricGet(m, ctypes.byref(val)) == 0
+    assert abs(val.value - 0.75) < 1e-6
+    assert lib.MXMetricReset(m) == 0
+    lib.MXMetricFree(m)
+
+
+def test_cpp_example_full_loop(tmp_path):
+    """Compile and run cpp-package/example/train_mlp.cc: the C++ side
+    writes a .rec, trains through ImageRecordIter batches and prints a
+    registry-metric accuracy — exit 0 means >0.9 (VERDICT r4 task 8)."""
+    import subprocess
+    root = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    if not os.path.exists(os.path.join(root, "src", "build",
+                                       "libmxtpu_train.so")):
+        pytest.skip("train lib not built")
+    exe = str(tmp_path / "train_mlp")
+    rc = subprocess.run(
+        ["g++", "-std=c++17", "-Icpp-package/include",
+         "cpp-package/example/train_mlp.cc", "-Lsrc/build",
+         "-lmxtpu_train", "-lmxtpu_io", "-o", exe],
+        cwd=root, capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr
+    env = dict(os.environ, PYTHONPATH=root, JAX_PLATFORMS="cpu",
+               LD_LIBRARY_PATH=os.path.join(root, "src", "build"))
+    env.pop("XLA_FLAGS", None)
+    run = subprocess.run([exe], cwd=root, env=env, capture_output=True,
+                         text=True, timeout=900)
+    assert run.returncode == 0, (run.stdout, run.stderr)
+    assert "eval accuracy" in run.stdout
