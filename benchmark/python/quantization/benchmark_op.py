@@ -162,6 +162,101 @@ def bench_fc(batch, in_features, num_hidden):
     return results
 
 
+def bench_serial_shape(fn, x0, ops, L1=128, L2=512, repeats=3):
+    """ms/op at ONE shape by the floor-cancelling serial chain.
+
+    A ``fori_loop`` chains L applications of ``fn`` inside one program —
+    each iteration's input is ``x0`` perturbed by a scalar probe of the
+    previous output (sub-ULP, but data-dependent: XLA can neither hoist
+    the loop-invariant op nor distribute the perturbation), so the chain
+    is strictly serial at ANY operand shape, not just square matmuls.
+    Timing two chain lengths and dividing the extra ops by the time
+    DIFFERENCE cancels the per-dispatch transport floor exactly — the
+    round-4 sweep's unresolved rows (every dtype ≈ the 0.5 ms/iter scan
+    floor) resolve under this method.
+    """
+    def make(L):
+        @jax.jit
+        def run(x0, *ops):
+            def body(_i, xc):
+                out = fn(xc, *ops)
+                lead = out[0] if isinstance(out, tuple) else out
+                probe = lead.reshape(-1)[0].astype(jnp.float32)
+                return x0 + (probe * 1e-20).astype(x0.dtype)
+            xf = jax.lax.fori_loop(0, L, body, x0)
+            return xf.reshape(-1)[0].astype(jnp.float32)
+        return run
+
+    def best(L):
+        prog = make(L)
+        float(prog(x0, *ops))          # compile + warm
+        b = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            float(prog(x0, *ops))      # host fetch = true sync
+            b = min(b, time.perf_counter() - t0)
+        return b
+
+    t1, t2 = best(L1), best(L2)
+    return max(t2 - t1, 1e-9) / (L2 - L1) * 1e3
+
+
+def bench_conv_serial(data_shape, kernel, num_filter, pad, stride,
+                      L1=128, L2=512):
+    """int8-vs-bf16 ratio at one reference conv shape (serial-chain)."""
+    rs = np.random.RandomState(0)
+    conv = get_op("Convolution").fcompute
+    qconv = get_op("_contrib_quantized_conv").fcompute
+    w_shape = (num_filter, data_shape[1]) + kernel
+    x32 = jnp.asarray(rs.normal(0, 0.2, data_shape), jnp.float32)
+    w32 = jnp.asarray(rs.normal(0, 1, w_shape), jnp.float32)
+
+    results = {}
+    for name, dt in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
+        results[name] = bench_serial_shape(
+            lambda a, b: conv(a, b, None, kernel=kernel, stride=stride,
+                              pad=pad, num_filter=num_filter, no_bias=True),
+            x32.astype(dt), (w32.astype(dt),), L1, L2)
+
+    x8 = jnp.clip(jnp.rint(x32 / jnp.abs(x32).max() * 127), -127,
+                  127).astype(jnp.int8)
+    w8 = jnp.clip(jnp.rint(w32 / jnp.abs(w32).max() * 127), -127,
+                  127).astype(jnp.int8)
+    mn, mx_ = jnp.float32(-1), jnp.float32(1)
+    results["int8"] = bench_serial_shape(
+        lambda a, b: qconv(a, b, mn, mx_, mn, mx_, kernel=kernel,
+                           stride=stride, pad=pad, num_filter=num_filter,
+                           no_bias=True)[0].astype(jnp.int8),
+        x8, (w8,), L1, L2)
+    return results
+
+
+def bench_fc_serial(batch, in_features, num_hidden, L1=128, L2=512):
+    """int8-vs-bf16 ratio at one reference FC shape (serial-chain)."""
+    rs = np.random.RandomState(0)
+    fc = get_op("FullyConnected").fcompute
+    qfc = get_op("_contrib_quantized_fully_connected").fcompute
+    x32 = jnp.asarray(rs.normal(0, 0.2, (batch, in_features)), jnp.float32)
+    w32 = jnp.asarray(rs.normal(0, 1, (num_hidden, in_features)),
+                      jnp.float32)
+
+    results = {}
+    for name, dt in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
+        results[name] = bench_serial_shape(
+            lambda a, b: fc(a, b, num_hidden=num_hidden, no_bias=True),
+            x32.astype(dt), (w32.astype(dt),), L1, L2)
+
+    x8 = jnp.clip(jnp.rint(x32 * 127), -127, 127).astype(jnp.int8)
+    w8 = jnp.clip(jnp.rint(w32 / jnp.abs(w32).max() * 127), -127,
+                  127).astype(jnp.int8)
+    mn, mx_ = jnp.float32(-1), jnp.float32(1)
+    results["int8"] = bench_serial_shape(
+        lambda a, b: qfc(a, b, mn, mx_, mn, mx_, num_hidden=num_hidden,
+                         no_bias=True)[0].astype(jnp.int8),
+        x8, (w8,), L1, L2)
+    return results
+
+
 def bench_serial_matmul(n=8192, repeats=30):
     """The conclusive int8-vs-bf16 probe: each iteration's matmul consumes
     the previous OUTPUT (renormalized), a dependency XLA cannot hoist or
@@ -219,7 +314,34 @@ def main():
     p.add_argument("--serial-probe", action="store_true",
                    help="serial-chain 8192^3 matmul: the conclusive "
                         "int8-vs-bf16 ratio")
+    p.add_argument("--serial-sweep", action="store_true",
+                   help="floor-cancelling serial chain at EVERY reference "
+                        "conv/fc shape (VERDICT r4 task 7)")
+    p.add_argument("--chain", type=int, default=128,
+                   help="serial-sweep L1 (L2 = 4*L1)")
     args = p.parse_args()
+    if args.serial_sweep:
+        for cfg in CONV_CONFIGS:
+            r = bench_conv_serial(*cfg, L1=args.chain, L2=4 * args.chain)
+            print(json.dumps({
+                "op": "conv_serial", "data_shape": cfg[0], "kernel": cfg[1],
+                "num_filter": cfg[2], "stride": cfg[4],
+                "f32_ms": round(r["f32"], 4), "bf16_ms": round(r["bf16"], 4),
+                "int8_ms": round(r["int8"], 4),
+                "int8_vs_f32": round(r["f32"] / r["int8"], 2),
+                "int8_vs_bf16": round(r["bf16"] / r["int8"], 2),
+            }), flush=True)
+        for cfg in FC_CONFIGS[:-1]:     # 8192^3 has the dedicated probe
+            r = bench_fc_serial(*cfg, L1=args.chain, L2=4 * args.chain)
+            print(json.dumps({
+                "op": "fc_serial", "batch": cfg[0], "in_features": cfg[1],
+                "num_hidden": cfg[2],
+                "f32_ms": round(r["f32"], 4), "bf16_ms": round(r["bf16"], 4),
+                "int8_ms": round(r["int8"], 4),
+                "int8_vs_f32": round(r["f32"] / r["int8"], 2),
+                "int8_vs_bf16": round(r["bf16"] / r["int8"], 2),
+            }), flush=True)
+        return
     if args.serial_probe:
         r = bench_serial_matmul()
         print(json.dumps({

@@ -72,10 +72,14 @@ def _build(network, batch_size, dtype):
 
 def score_steady(network, batch_size, chain=100, repeats=2,
                  dtype="bfloat16", fn_params=None, x=None):
-    """img/s with the per-dispatch floor amortized over ``chain`` chained
-    forwards in one XLA program.  ``fn_params``/``x`` override the model
-    (used by the quantization bench to time an already-transformed
-    forward through the identical harness)."""
+    """img/s by the TWO-POINT chained method: time a K-chain and a
+    2K-chain program and divide the K extra forwards by the time
+    DIFFERENCE — the per-dispatch transport floor appears in both
+    measurements and cancels exactly, so even batch-1 points measure the
+    chip (a single-chain rate still carries floor/(K·t) bias, which made
+    resnet-152 read faster than resnet-50 at b1).  ``fn_params``/``x``
+    override the model (used by the quantization bench to time an
+    already-transformed forward through the identical harness)."""
     import jax
     import jax.numpy as jnp
 
@@ -87,27 +91,35 @@ def score_steady(network, batch_size, chain=100, repeats=2,
     else:
         fn, params = fn_params
 
-    @jax.jit
-    def chained(params, x0):
-        def body(carry, _):
-            out = fn(params, x0 + carry)
-            # scalar probe of THIS output feeds the NEXT input: the loop
-            # body is not loop-invariant, so XLA executes all K forwards.
-            # 1e-20 keeps the perturbation sub-ULP for realistic inputs
-            # (and is exactly representable in bf16's f32 exponent range)
-            p = out.reshape(-1)[0].astype(jnp.float32)
-            return (p * 1e-20).astype(x0.dtype), p
-        _, probes = jax.lax.scan(
-            body, jnp.zeros((), x0.dtype), None, length=chain)
-        return probes.sum()
+    def make(length):
+        @jax.jit
+        def chained(params, x0):
+            def body(carry, _):
+                out = fn(params, x0 + carry)
+                # scalar probe of THIS output feeds the NEXT input: the
+                # loop body is not loop-invariant, so XLA executes all K
+                # forwards.  1e-20 keeps the perturbation sub-ULP for
+                # realistic inputs (and is exactly representable in
+                # bf16's f32 exponent range)
+                p = out.reshape(-1)[0].astype(jnp.float32)
+                return (p * 1e-20).astype(x0.dtype), p
+            _, probes = jax.lax.scan(
+                body, jnp.zeros((), x0.dtype), None, length=length)
+            return probes.sum()
+        return chained
 
-    float(chained(params, x))                # compile + warm
-    best = float("inf")
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        float(chained(params, x))            # host fetch = true sync
-        best = min(best, time.perf_counter() - t0)
-    return chain * batch_size / best
+    def best_time(fn_c):
+        float(fn_c(params, x))               # compile + warm
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            float(fn_c(params, x))           # host fetch = true sync
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1 = best_time(make(chain))
+    t2 = best_time(make(2 * chain))
+    return chain * batch_size / max(t2 - t1, 1e-9)
 
 
 def score_eager(network, batch_size, num_batches=10, dtype="bfloat16"):
