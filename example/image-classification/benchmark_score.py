@@ -117,8 +117,15 @@ def score_steady(network, batch_size, chain=100, repeats=2,
             best = min(best, time.perf_counter() - t0)
         return best
 
-    t1 = best_time(make(chain))
-    t2 = best_time(make(2 * chain))
+    # adaptive: when the K-vs-2K difference is inside dispatch jitter
+    # (small model × small batch), quadruple K until the chained compute
+    # clearly dominates — otherwise b1 rows read noise, up to 1/eps
+    while True:
+        t1 = best_time(make(chain))
+        t2 = best_time(make(2 * chain))
+        if t2 - t1 > 0.33 * t1 or chain >= 6400:
+            break
+        chain *= 4
     return chain * batch_size / max(t2 - t1, 1e-9)
 
 
