@@ -272,6 +272,52 @@ def _quantized_flatten(data, min_data, max_data):
             jnp.asarray(max_data, jnp.float32).reshape(()))
 
 
+@register("_contrib_quantized_act", num_inputs=3, num_outputs=3,
+          input_names=("data", "min_data", "max_data"),
+          differentiable=False)
+def _quantized_act(data, min_data, max_data, act_type="relu"):
+    """relu directly on the int8 grid (ref: the role of MKLDNN's fused
+    conv+relu subgraphs — round 5 adds it as a first-class op because
+    XLA cannot fuse across an int8 dequantize boundary).  Symmetric
+    zero-centered codes make relu a plain elementwise max with 0; the
+    code->value scale is unchanged, so the range passes through (the
+    negative half of the grid simply goes unused)."""
+    zero = jnp.zeros((), data.dtype)
+    return (jnp.maximum(data, zero),
+            jnp.asarray(min_data, jnp.float32).reshape(()) * 1,
+            jnp.asarray(max_data, jnp.float32).reshape(()) * 1)
+
+
+@register("_contrib_quantized_elemwise_add", num_inputs=6, num_outputs=3,
+          input_names=("lhs", "rhs", "min_lhs", "max_lhs",
+                       "min_rhs", "max_rhs"),
+          differentiable=False)
+def _quantized_elemwise_add(lhs, rhs, min_lhs, max_lhs, min_rhs, max_rhs):
+    """Residual add without leaving the quantized domain.
+
+    The two int8 operands carry different scales, so each code is
+    rescaled onto a common int32 accumulator grid whose extremes map to
+    ±(r_lhs + r_rhs) — the exact bound of the sum — and the add happens
+    there; a requantize (NEED_REQUANTIZE) narrows back to int8.  The
+    per-element math is two fused multiply-adds in registers: no f32
+    tensor ever touches HBM, which is the entire point (a dequantized
+    residual add costs three full f32 activation passes).
+    ref: the reference gains this from MKLDNN sum fusion; modeled on
+    quantization_utils.h QuantizationRangeForMultiplication style
+    range algebra."""
+    ra = _real_range(jnp.asarray(min_lhs, jnp.float32).reshape(()),
+                     jnp.asarray(max_lhs, jnp.float32).reshape(()))
+    rb = _real_range(jnp.asarray(min_rhs, jnp.float32).reshape(()),
+                     jnp.asarray(max_rhs, jnp.float32).reshape(()))
+    r_out = ra + rb
+    acc = float(np.iinfo(np.int32).max)
+    ka = ra * (acc / (INT8_MAX * r_out))     # int32 units per lhs code
+    kb = rb * (acc / (INT8_MAX * r_out))
+    out = jnp.rint(lhs.astype(jnp.float32) * ka
+                   + rhs.astype(jnp.float32) * kb).astype(jnp.int32)
+    return out, -r_out, r_out * 1
+
+
 # ---------------------------------------------------------------------------
 # Graph-pass metadata: which float ops have a quantized twin, and which
 # quantized ops emit int32 that must be requantized (ref: FQuantizedOp /
@@ -283,10 +329,15 @@ QUANTIZED_OP_MAP = {
     "FullyConnected": "_contrib_quantized_fully_connected",
     "Pooling": "_contrib_quantized_pooling",
     "Flatten": "_contrib_quantized_flatten",
+    "Activation": "_contrib_quantized_act",
+    # elemwise_add aliases to broadcast_add in the registry: map both
+    "elemwise_add": "_contrib_quantized_elemwise_add",
+    "broadcast_add": "_contrib_quantized_elemwise_add",
 }
 
 NEED_REQUANTIZE = {"_contrib_quantized_conv",
-                   "_contrib_quantized_fully_connected"}
+                   "_contrib_quantized_fully_connected",
+                   "_contrib_quantized_elemwise_add"}
 
 # float-op params that the quantized twin does not accept
 _DROP_PARAMS = {"Flatten": ("axis",)}
@@ -294,9 +345,13 @@ _DROP_PARAMS = {"Flatten": ("axis",)}
 
 def quantizable(op_name, params):
     """Whether this node can be replaced by its int8 twin under ``params``
-    (Pooling only for max/avg, matching quantized_pooling.cc)."""
+    (Pooling only for max/avg, matching quantized_pooling.cc; Activation
+    only for relu — the int8 grid is relu-closed, other activations
+    need the float path)."""
     if op_name not in QUANTIZED_OP_MAP:
         return False
     if op_name == "Pooling" and params.get("pool_type", "max") not in ("max", "avg"):
+        return False
+    if op_name == "Activation" and params.get("act_type") != "relu":
         return False
     return True

@@ -347,3 +347,49 @@ def test_fold_batchnorm_refuses_unsafe_patterns():
     fsym3, _, faux3 = qz.fold_batchnorm(b3, args3, aux3)
     ops3 = [n._op.name for n in fsym3._topo() if not n.is_variable()]
     assert "BatchNorm" in ops3 and faux3, "axis!=1 must refuse to fold"
+
+
+def test_quantized_resnet_is_single_int8_chain():
+    """With quantized relu + residual-add twins (round 5), a folded
+    ResNet quantizes into ONE int8 chain: exactly one _contrib_quantize
+    (the input) and one _contrib_dequantize (the output) — no per-layer
+    float round-trips (the round-4 graph had 17 of them on resnet-18,
+    which is why int8 lost end-to-end)."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "sym_resnet", os.path.join(
+            os.path.dirname(__file__), "..", "example",
+            "image-classification", "symbols", "resnet.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    rng = np.random.RandomState(0)
+    net = m.get_symbol(num_classes=10, num_layers=18, thumbnail=True)
+    pred = net.get_internals()["fc1_output"]
+    shapes, _, aux_shapes = pred.infer_shape(data=(2, 3, 32, 32))
+    args = {n: nd.array(rng.randn(*s).astype(np.float32) * 0.1)
+            for n, s in zip(pred.list_arguments(), shapes) if n != "data"}
+    aux = {n: nd.array(np.ones(s, np.float32) if "var" in n
+                       else np.zeros(s, np.float32))
+           for n, s in zip(pred.list_auxiliary_states(), aux_shapes)}
+    fsym, fargs, _ = qz.fold_batchnorm(pred, args, aux)
+    calib = rng.uniform(-1, 1, (4, 3, 32, 32)).astype(np.float32)
+    qsym, qargs, _ = qz.quantize_model(
+        fsym, fargs, {}, calib_mode="naive",
+        calib_data=io.NDArrayIter(data=calib, batch_size=4),
+        num_calib_examples=4)
+    counts = {}
+    for n in qsym._topo():
+        if not n.is_variable():
+            counts[n._op.name] = counts.get(n._op.name, 0) + 1
+    assert counts.get("_contrib_quantize", 0) == 1, counts
+    assert counts.get("_contrib_dequantize", 0) == 1, counts
+    assert counts.get("_contrib_quantized_act", 0) > 0
+    assert counts.get("_contrib_quantized_elemwise_add", 0) > 0
+    # numerics hold through the full chain
+    x = nd.array(rng.uniform(-1, 1, (4, 3, 32, 32)).astype(np.float32))
+    ref = fsym.bind(mx.cpu(), {**fargs, "data": x},
+                    grad_req="null").forward(is_train=False)[0].asnumpy()
+    got = qsym.bind(mx.cpu(), {**qargs, "data": x},
+                    grad_req="null").forward(is_train=False)[0].asnumpy()
+    assert np.abs(got - ref).mean() / (ref.std() + 1e-9) < 0.05
