@@ -165,15 +165,24 @@ def bench_fc(batch, in_features, num_hidden):
 def bench_serial_shape(fn, x0, ops, L1=128, L2=512, repeats=3):
     """ms/op at ONE shape by the floor-cancelling serial chain.
 
-    A ``fori_loop`` chains L applications of ``fn`` inside one program —
-    each iteration's input is ``x0`` perturbed by a scalar probe of the
-    previous output (sub-ULP, but data-dependent: XLA can neither hoist
-    the loop-invariant op nor distribute the perturbation), so the chain
-    is strictly serial at ANY operand shape, not just square matmuls.
-    Timing two chain lengths and dividing the extra ops by the time
-    DIFFERENCE cancels the per-dispatch transport floor exactly — the
-    round-4 sweep's unresolved rows (every dtype ≈ the 0.5 ms/iter scan
-    floor) resolve under this method.
+    A ``fori_loop`` chains L applications of ``fn`` inside one program;
+    each iteration writes a scalar probe of its output INTO the carried
+    input via ``dynamic_update_slice`` (element [0..0], sub-ULP value).
+    Construction notes — three cheaper dependences all get optimized
+    away (verified in compiled HLO):
+    * additive/multiplicative scalar perturbation: conv/fc are linear,
+      so XLA rewrites ``fn(x0 + s) = fn(x0) + s·fn(1)`` and hoists the
+      loop-invariant part (measured: >5 PFLOP/s readings);
+    * select-on-predicate rebinding: the select sinks / the op hoists;
+    * optimization_barrier: the barrier's unused output is DCE'd and
+      the op with it (0 dot ops left in the compiled module).
+    DUS on the CARRY is in-place (no per-iteration copy — DUS on the
+    invariant x0 forces a full-tensor copy each iteration) and nothing
+    distributes through a point update, so the op stays in the loop
+    body.  Timing two chain lengths and dividing the extra ops by the
+    time DIFFERENCE cancels the per-dispatch transport floor exactly —
+    the round-4 sweep's unresolved rows (every dtype ≈ the 0.5 ms/iter
+    scan floor) resolve under this method.
     """
     def make(L):
         @jax.jit
@@ -181,8 +190,11 @@ def bench_serial_shape(fn, x0, ops, L1=128, L2=512, repeats=3):
             def body(_i, xc):
                 out = fn(xc, *ops)
                 lead = out[0] if isinstance(out, tuple) else out
-                probe = lead.reshape(-1)[0].astype(jnp.float32)
-                return x0 + (probe * 1e-20).astype(x0.dtype)
+                probe = (lead.reshape(-1)[0].astype(jnp.float32)
+                         * 1e-20).astype(x0.dtype)
+                return jax.lax.dynamic_update_slice(
+                    xc, probe.reshape((1,) * x0.ndim),
+                    (0,) * x0.ndim)
             xf = jax.lax.fori_loop(0, L, body, x0)
             return xf.reshape(-1)[0].astype(jnp.float32)
         return run
@@ -197,7 +209,15 @@ def bench_serial_shape(fn, x0, ops, L1=128, L2=512, repeats=3):
             b = min(b, time.perf_counter() - t0)
         return b
 
-    t1, t2 = best(L1), best(L2)
+    # adaptive: reference shapes run in tens of µs, so the K-vs-4K time
+    # difference must be grown until it clears dispatch jitter (same
+    # discipline as benchmark_score.score_steady)
+    while True:
+        t1, t2 = best(L1), best(L2)
+        if t2 - t1 > 0.33 * t1 or L2 >= 32768:
+            break
+        L1 *= 4
+        L2 *= 4
     return max(t2 - t1, 1e-9) / (L2 - L1) * 1e3
 
 

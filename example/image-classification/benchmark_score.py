@@ -95,16 +95,21 @@ def score_steady(network, batch_size, chain=100, repeats=2,
         @jax.jit
         def chained(params, x0):
             def body(carry, _):
-                out = fn(params, x0 + carry)
-                # scalar probe of THIS output feeds the NEXT input: the
-                # loop body is not loop-invariant, so XLA executes all K
-                # forwards.  1e-20 keeps the perturbation sub-ULP for
-                # realistic inputs (and is exactly representable in
-                # bf16's f32 exponent range)
+                out = fn(params, carry)
+                # a scalar probe of THIS output is written INTO the
+                # carried input (dynamic_update_slice, element [0...],
+                # sub-ULP value): the op chain stays strictly serial and
+                # nothing hoists.  An additive scalar probe is NOT safe:
+                # the model's FIRST layer is linear, so XLA distributes
+                # fn1(x0+s) = fn1(x0) + s*fn1(1) and hoists the
+                # loop-invariant fn1(x0) out of the scan (see
+                # benchmark_op.bench_serial_shape's HLO-verified notes).
                 p = out.reshape(-1)[0].astype(jnp.float32)
-                return (p * 1e-20).astype(x0.dtype), p
-            _, probes = jax.lax.scan(
-                body, jnp.zeros((), x0.dtype), None, length=length)
+                nxt = jax.lax.dynamic_update_slice(
+                    carry, (p * 1e-20).astype(x0.dtype).reshape(
+                        (1,) * x0.ndim), (0,) * x0.ndim)
+                return nxt, p
+            _, probes = jax.lax.scan(body, x0, None, length=length)
             return probes.sum()
         return chained
 
