@@ -367,3 +367,26 @@ def test_cast():
     assert net.weight.data().dtype == np.dtype("bfloat16")
     x = mx.nd.array(np.ones((1, 2), np.float32)).astype("bfloat16")
     assert net(x).dtype == np.dtype("bfloat16")
+
+
+def test_functionalize_threads_rng():
+    """functionalize's rng keyword must control stochastic ops: same key
+    -> same dropout mask, fresh keys -> different masks (review finding:
+    the first cut baked one host key into the trace)."""
+    import jax
+    from incubator_mxnet_tpu.gluon.block import functionalize
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32), nn.Dropout(0.5))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.random.uniform(shape=(4, 8))
+    fn, params = functionalize(net, x, train=True)
+    jfn = jax.jit(fn)
+    xv = x._read()
+    a = np.asarray(jfn(params, xv, rng=jax.random.PRNGKey(1)))
+    b = np.asarray(jfn(params, xv, rng=jax.random.PRNGKey(1)))
+    c = np.asarray(jfn(params, xv, rng=jax.random.PRNGKey(2)))
+    np.testing.assert_array_equal(a, b)
+    assert (a != c).any(), "different keys must give different masks"
+    assert ((a == 0).mean() > 0.2), "dropout inactive in train trace"
