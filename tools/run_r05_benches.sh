@@ -28,3 +28,6 @@ python benchmark/python/sparse/updater.py --bulk 16 \
 # 5. transformer MFU with the corrected (non-embedding) accounting
 python bench_transformer.py > "$OUT/transformer_mfu.jsonl" \
     2> /tmp/r05_tf.err
+
+# 6. eager micro-bench (bulk now also defers the optimizer updates)
+python bench_eager.py > "$OUT/eager_bulk.jsonl" 2> /tmp/r05_eager.err
