@@ -1,29 +1,31 @@
 #!/bin/bash
-# Round-5 TPU measurement set, in dependency order.  Run from the repo
-# root with the axon tunnel live; each stage appends JSON lines under
-# docs/bench_results_r05/.  Stages are independent — a tunnel drop only
-# loses the stage in flight (rerun just that line).
+# Round-5 TPU measurement set.  Run from the repo root with the axon
+# tunnel live; each stage writes JSON lines under docs/bench_results_r05/.
+# Stages are independent — a tunnel drop only loses the stage in flight.
+# Ordered by evidence value: artifacts that have never been measured
+# with the honest (DUS-chain) harness come first.
 set -x
 OUT=docs/bench_results_r05
 mkdir -p "$OUT"
 
-# 1. chip-true inference sweep (two-point DUS harness)
-python example/image-classification/benchmark_score.py --mode steady \
-    --chain 100 > "$OUT/inference_steady.jsonl" 2> /tmp/r05_sweep.err
-
-# 2. quantized resnet-50 end-to-end (same harness; int8 single chain)
-python example/quantization/imagenet_inference.py --chain 50 \
-    --calib-mode naive > "$OUT/quantized_resnet50.jsonl" 2> /tmp/r05_quant.err
-
-# 3. INT8 op ratios at reference shapes (serial DUS chain)
+# 1. INT8 op ratios at reference shapes (serial DUS chain) — round-4
+#    verdict task 7, no prior honest measurement exists
 python benchmark/python/quantization/benchmark_op.py --serial-sweep \
     --chain 256 > "$OUT/int8_serial_shapes.jsonl" 2> /tmp/r05_serial.err
 
-# 4. sparse updater with and without bulk
+# 2. sparse updater with and without bulk — verdict task 4's Done bar
 python benchmark/python/sparse/updater.py \
     > "$OUT/updater_eager.jsonl" 2> /tmp/r05_upd1.err
 python benchmark/python/sparse/updater.py --bulk 16 \
     > "$OUT/updater_bulk.jsonl" 2> /tmp/r05_upd2.err
+
+# 3. quantized resnet-50 end-to-end (DUS harness refresh)
+python example/quantization/imagenet_inference.py --chain 50 \
+    --calib-mode naive > "$OUT/quantized_resnet50.jsonl" 2> /tmp/r05_quant.err
+
+# 4. chip-true inference sweep refresh (two-point DUS harness)
+python example/image-classification/benchmark_score.py --mode steady \
+    --chain 100 > "$OUT/inference_steady_dus.jsonl" 2> /tmp/r05_sweep.err
 
 # 5. transformer MFU with the corrected (non-embedding) accounting
 python bench_transformer.py > "$OUT/transformer_mfu.jsonl" \
