@@ -40,6 +40,24 @@ def _chain_eager(a, b, c, n):
     return a
 
 
+def _chain_views(a, b, c, n):
+    """Same budget of compute ops, but with the reshape/transpose glue of
+    a real model body interleaved (round 6: views defer, so this must
+    still flush as one program per scope)."""
+    x = a
+    h, w = SHAPE
+    for _ in range(n // 4):
+        x = x * b
+        x = x.reshape((h * w,))        # view
+        x = x + 1.0
+        x = x.reshape(SHAPE)           # view
+        x = x.transpose((1, 0))        # shape op
+        x = x.abs()
+        x = x[0:h]                     # basic-slice view (full range)
+        x = x - c
+    return x
+
+
 def main():
     import jax
     import incubator_mxnet_tpu as mx
@@ -71,6 +89,30 @@ def main():
     out.asnumpy()
     dt_bulkscope = time.perf_counter() - t0
     bulkscope_ops = CHAIN * ITERS / dt_bulkscope
+
+    # -- VIEW-GLUE variant (round 6): reshape/transpose/slice interleaved
+    # with the compute ops.  Views defer, so the whole chain must still
+    # be ONE program per scope; flush-cause counters + segment-length
+    # histogram make the claim auditable (and regressions visible).
+    _chain_views(a, b, c, CHAIN).asnumpy()          # warm per-op caches
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = _chain_views(a, b, c, CHAIN)
+    out.asnumpy()
+    dt_views_eager = time.perf_counter() - t0
+    with mx.engine.bulk(4 * CHAIN):
+        _chain_views(a, b, c, CHAIN).asnumpy()      # compile the replay
+    mx.engine.reset_flush_stats()
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        with mx.engine.bulk(4 * CHAIN):
+            out = _chain_views(a, b, c, CHAIN)
+    out.asnumpy()
+    dt_views_bulk = time.perf_counter() - t0
+    view_stats = mx.engine.flush_stats()
+    view_flushes = sum(view_stats["causes"].values())
+    views_eager_ops = CHAIN * ITERS / dt_views_eager
+    views_bulk_ops = CHAIN * ITERS / dt_views_bulk
 
     class Chain(gluon.HybridBlock):
         def hybrid_forward(self, F, a, b, c):
@@ -116,11 +158,13 @@ def main():
     dt_train_eager = time.perf_counter() - t0
 
     _train_step(True).asnumpy()         # compile replay + segment vjp
+    mx.engine.reset_flush_stats()
     t0 = time.perf_counter()
     for _ in range(ITERS):
         loss = _train_step(True)
     loss.asnumpy()
     dt_train_bulk = time.perf_counter() - t0
+    train_stats = mx.engine.flush_stats()
     train_eager_ops = CHAIN * ITERS / dt_train_eager
     train_bulk_ops = CHAIN * ITERS / dt_train_bulk
 
@@ -133,9 +177,24 @@ def main():
         "hybridized_ops_per_sec": round(bulk_ops, 1),
         "engine_bulk_speedup": round(bulkscope_ops / eager_ops, 2),
         "hybridize_speedup": round(bulk_ops / eager_ops, 2),
+        "view_chain_eager_ops_per_sec": round(views_eager_ops, 1),
+        "view_chain_bulk_ops_per_sec": round(views_bulk_ops, 1),
+        "view_chain_bulk_speedup": round(views_bulk_ops / views_eager_ops,
+                                         2),
+        # ops-per-dispatch over the view-glue chain: ITERS scopes should
+        # cost exactly ITERS replay dispatches (views no longer fragment)
+        "view_chain_flushes": view_flushes,
+        "view_chain_ops_per_dispatch": round(CHAIN * ITERS
+                                             / max(view_flushes, 1), 1),
+        "view_chain_flush_causes": view_stats["causes"],
+        "view_chain_segment_len_hist": {str(k): v for k, v in sorted(
+            view_stats["segment_lengths"].items())},
         "train_eager_ops_per_sec": round(train_eager_ops, 1),
         "train_bulk_ops_per_sec": round(train_bulk_ops, 1),
         "train_bulk_speedup": round(train_bulk_ops / train_eager_ops, 2),
+        "train_flush_causes": train_stats["causes"],
+        "train_segment_len_hist": {str(k): v for k, v in sorted(
+            train_stats["segment_lengths"].items())},
     }))
 
 

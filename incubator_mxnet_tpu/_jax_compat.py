@@ -1,0 +1,23 @@
+"""Version-compatibility shims over the installed jax.
+
+The codebase targets the modern ``jax.shard_map`` surface (keyword
+``check_vma``); older jax releases only ship
+``jax.experimental.shard_map.shard_map`` whose equivalent keyword is
+``check_rep``.  Route every caller through here so the rest of the tree
+can use one spelling regardless of the installed version.
+"""
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map
+    _CHECK_KW = "check_vma"
+except ImportError:                      # jax < 0.6: experimental API
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+    if check_vma is not None:
+        kw[_CHECK_KW] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)

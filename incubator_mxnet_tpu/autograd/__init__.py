@@ -135,7 +135,7 @@ def _run_backward(heads, head_grads, retain_graph, train_mode, variables=None,
     # any bulk-deferred segment must land its tape node before the walk
     # (a recorded segment only becomes a node at flush)
     from .. import engine as _engine
-    _engine.flush()
+    _engine.flush(cause="autograd")
 
     s = _st()
     tape = list(s.tape)

@@ -34,12 +34,36 @@ output at record time, provided the target is a plain non-view NDArray
 and the inferred output matches its shape/dtype exactly — otherwise the
 op runs eagerly with the usual astype/write-through fixups.
 
+VIEW ops are deferrable (round 6 — the reference bulks the reshape/
+transpose glue of real model bodies into the same segment,
+threaded_engine.h:472-509): a view taken of a deferred value becomes a
+new _Pending whose program node is the corresponding shape op
+(``_bulk_view_extract``: flat slice + reshape, exactly NDArray._read's
+concrete math), so reshape/reshape_like/expand_dims/``__getitem__``
+basic slicing/at/slice over a pending keep the segment open —
+transpose/swapaxes/squeeze are ordinary registered ops and defer
+through the normal path.  Write-through to a deferred view records a
+``_bulk_view_write`` (lax.dynamic_update_slice into the base's flat
+buffer) in the same program and rebinds the base to the new pending.
+Liveness treats base and view as one ownership group: the view holds a
+strong ref to its base NDArray, so a live view keeps its base's pending
+live, and a dead view's extract node is eliminated like any other dead
+value.  Views still MATERIALIZE (one flush, counted under the ``view``
+flush cause) when the base pending belongs to another scope/segment,
+for sparse storage, and for fancy/multi-axis indexing — those read
+concrete buffers by construction.
+
 Out of scope for deferral (dispatched eagerly, exactly as before):
 recorded ops with ``out=``, sparse storage, ops that manage their own
 mesh placement (no_jit), and NaiveEngine mode.
-VIEW creation (reshape/slice) over a deferred value materializes it —
-views share storage with their base, which must be concrete for
-write-through; keep chains view-free for maximal segments.
+
+Every flush is attributed to a cause — ``scope-close`` (bulk.__exit__),
+``size-cap`` (segment hit ``size``), ``view`` (a non-deferrable view
+materialized its base), ``read`` (asnumpy/_read of a deferred value),
+``autograd`` (backward landing the segment's tape node) — and the
+per-flush instruction count feeds a segment-length histogram; see
+``flush_stats()`` / ``reset_flush_stats()``.  bench_eager.py reports
+both so segment fragmentation is visible per round.
 """
 from __future__ import annotations
 
@@ -49,7 +73,7 @@ import weakref
 import jax
 import jax.numpy as jnp
 
-__all__ = ["bulk", "flush"]
+__all__ = ["bulk", "flush", "flush_stats", "reset_flush_stats"]
 
 
 class _Pending(object):
@@ -126,6 +150,23 @@ _infer_cache = {}   # (op, input sig, params, train) -> output sig; shape
 # inference via jax.eval_shape costs ~a dispatch itself, so recording
 # would be slower than executing without this memo
 
+_FLUSH_CAUSES = ("scope-close", "size-cap", "view", "read", "autograd")
+_flush_causes = {c: 0 for c in _FLUSH_CAUSES}
+_segment_hist = {}   # instructions-per-flush -> count
+
+
+def flush_stats():
+    """Flush-cause counters and the segment-length histogram (counted
+    only for flushes that actually executed instructions)."""
+    return {"causes": dict(_flush_causes),
+            "segment_lengths": dict(_segment_hist)}
+
+
+def reset_flush_stats():
+    for c in _FLUSH_CAUSES:
+        _flush_causes[c] = 0
+    _segment_hist.clear()
+
 
 def _current():
     return getattr(_tls, "state", None)
@@ -145,7 +186,7 @@ class bulk(object):
 
     def __exit__(self, *exc):
         try:
-            flush()
+            flush(cause="scope-close")
         finally:
             _tls.state = self._prev
 
@@ -169,7 +210,7 @@ def maybe_defer(op, params, vals, is_train, kw, rec=False, nd_inputs=None,
         # flush BEFORE recording the next op (never right after one: the
         # freshly created outputs get their owner refs only once invoke
         # wraps them — flushing in between would mis-classify them dead)
-        flush()
+        flush(cause="size-cap")
     from .ops.registry import _hashable
     # stage input refs WITHOUT touching st yet: if we bail (stale
     # pending, failed inference) no orphan ext entries may pollute the
@@ -216,10 +257,67 @@ def maybe_defer(op, params, vals, is_train, kw, rec=False, nd_inputs=None,
     return tuple(outs)
 
 
-def resolve(pending):
+def defer_view_read(view):
+    """Record a ``_bulk_view_extract`` node for a (base, offset, shape)
+    view whose base is deferred: the view's value becomes a new _Pending
+    in the same program instead of a materialization point.  Returns the
+    pending (registered as owned by ``view``), or None when deferral is
+    impossible (no scope / cross-scope base) — caller falls back to the
+    concrete read, which flushes under the ``view`` cause.
+
+    Recorded with rec=False: in eager execution a view created outside
+    recording enters the tape as a constant leaf, so the replay's
+    stop_gradient wrap reproduces those semantics exactly.  Views created
+    *inside* record() never reach here — reshape/__getitem__ route through
+    the registered Reshape/slice_axis ops under recording."""
+    st = _current()
+    if st is None:
+        return None
+    base = view._base
+    if type(base._data) is not _Pending or base._data.value is not None:
+        return None
+    from .ops.registry import get_op
+    pend = maybe_defer(get_op("_bulk_view_extract"),
+                       {"offset": int(view._offset),
+                        "shape": tuple(view._shape)},
+                       [base._data], False, {}, nd_inputs=[base])
+    if pend is None:
+        return None
+    p = pend[0]
+    p.owners.append(weakref.ref(view))
+    return p
+
+
+def defer_view_write(view, value):
+    """Record a ``_bulk_view_write`` node: the base's buffer is rebound to
+    a new pending whose program node scatters ``value`` (concrete array or
+    same-segment pending) over the view's span — write-through to a
+    deferred view stays inside the segment.  Returns the base's new
+    pending (owned by the base NDArray), or None to fall back to the
+    concrete write-through path."""
+    st = _current()
+    if st is None:
+        return None
+    base = view._base
+    bval = base._data
+    if not (type(bval) is _Pending and bval.value is None) \
+            and not (type(value) is _Pending and value.value is None):
+        return None          # nothing deferred: the concrete path is fine
+    from .ops.registry import get_op
+    pend = maybe_defer(get_op("_bulk_view_write"),
+                       {"offset": int(view._offset)},
+                       [bval, value], False, {}, nd_inputs=[base, None])
+    if pend is None:
+        return None
+    p = pend[0]
+    p.owners.append(weakref.ref(base))
+    return p
+
+
+def resolve(pending, cause="read"):
     """Materialize one deferred value (flushes its segment if needed)."""
     if pending.value is None:
-        flush(pending.state)
+        flush(pending.state, cause=cause)
     if pending.error is not None:
         raise RuntimeError("bulk engine: the deferred segment holding this "
                            "value failed to execute") from pending.error
@@ -359,11 +457,14 @@ def _record_segment_node(key, replay, ext, ext_owners, pendings, live,
     autograd._record(op, nd_inputs, nd_outputs, seg_vjp, fn=seg_fn)
 
 
-def flush(state=None):
+def flush(state=None, cause="read"):
     """Compile (cached) + run the pending segment; fill every _Pending."""
     st = state if state is not None else _current()
     if st is None or not st.instructions:
         return
+    _flush_causes[cause] = _flush_causes.get(cause, 0) + 1
+    _segment_hist[len(st.instructions)] = \
+        _segment_hist.get(len(st.instructions), 0) + 1
     instrs = st.instructions
     ext = st.ext
     ext_owners = st.ext_owners
@@ -383,9 +484,15 @@ def flush(state=None):
     # pending — a chained out= store rebinds the owner to each successive
     # pending, and without the `_data is p` check every superseded
     # intermediate would escape the program as a dead output (review
-    # finding, round 5: N-long update chains shipped N-1 dead buffers)
+    # finding, round 5: N-long update chains shipped N-1 dead buffers).
+    # A view owner additionally needs its extract to be CURRENT: once the
+    # base version moves past the view's cache, every read recomputes
+    # from the base and the stale extract can never be resolved — it is
+    # dead even though `_data is p` still holds
     live = tuple(i for i, p in enumerate(pendings)
                  if any(o is not None and o._data is p
+                        and (o._base is None
+                             or o._cache_version == o._base._version)
                         for o in (w() for w in p.owners)))
     key = (tuple((name, pkey, train, in_refs, rng_slot, n_out, rec)
                  for name, _p, pkey, train, in_refs, rng_slot, n_out, rec

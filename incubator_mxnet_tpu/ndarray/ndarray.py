@@ -17,6 +17,8 @@ TPU-native rebirth of include/mxnet/ndarray.h + src/ndarray/ndarray.cc:
 """
 from __future__ import annotations
 
+import weakref
+
 import numpy as np
 
 import jax
@@ -81,16 +83,27 @@ class NDArray:
     def _root(self):
         return self._base if self._base is not None else self
 
-    def _read(self):
-        """Current jax.Array value (no host sync)."""
+    def _read(self, cause="read"):
+        """Current jax.Array value (no host sync).  ``cause`` labels any
+        flush this read forces: "read" for direct host reads of deferred
+        values, "view" only when the _read_deferred fallback lands here
+        after a view failed to defer."""
+        eng = _engine_mod()
         if self._base is None:
-            if type(self._data) is _engine_mod()._Pending:
-                self._data = _engine_mod().resolve(self._data)
+            if type(self._data) is eng._Pending:
+                self._data = eng.resolve(self._data, cause=cause)
             return self._data
         b = self._base
-        if type(b._data) is _engine_mod()._Pending:
-            b._data = _engine_mod().resolve(b._data)
-        if self._cache_version != b._version or self._data is None:
+        if (type(self._data) is eng._Pending
+                and self._cache_version == b._version):
+            # a deferred view extraction for the current base version:
+            # resolving it flushes the shared segment (base fills too)
+            self._data = eng.resolve(self._data, cause=cause)
+            return self._data
+        if type(b._data) is eng._Pending:
+            b._data = eng.resolve(b._data, cause=cause)
+        if self._cache_version != b._version or self._data is None \
+                or type(self._data) is eng._Pending:
             flat = b._data.reshape((-1,))
             size = int(np.prod(self._shape)) if self._shape else 1
             self._data = jax.lax.slice(flat, (self._offset,), (self._offset + size,)).reshape(self._shape)
@@ -100,30 +113,62 @@ class NDArray:
     def _read_deferred(self):
         """Like _read, but inside an active bulk scope an unresolved
         deferred value is returned as its _Pending placeholder so op
-        chains keep deferring (engine.py maybe_defer)."""
+        chains keep deferring (engine.py maybe_defer).  A view over a
+        deferred base becomes a recorded ``_bulk_view_extract`` pending
+        (round 6) instead of a materialization point."""
+        eng = _engine_mod()
         d = self._data
-        if (self._base is None and type(d) is _engine_mod()._Pending
-                and d.value is None):
-            return d
+        if self._base is None:
+            if type(d) is eng._Pending and d.value is None:
+                return d
+            return self._read()
+        b = self._base
+        if type(b._data) is eng._Pending and b._data.value is None:
+            if (type(d) is eng._Pending and d.value is None
+                    and self._cache_version == b._version):
+                return d            # extraction already recorded this epoch
+            p = eng.defer_view_read(self)
+            if p is not None:
+                self._data = p
+                self._cache_version = b._version
+                return p
+            # deferral failed (cross-scope base …): this flush IS view
+            # fragmentation — attribute it so the counters catch it
+            return self._read(cause="view")
         return self._read()
 
     def _write(self, value):
-        """Replace contents (in-place semantics; bumps the version 'var')."""
+        """Replace contents (in-place semantics; bumps the version 'var').
+
+        ``value`` may be a _Pending (deferred op output): roots simply
+        rebind to it, and a view over a deferred base records the
+        write-through as a ``_bulk_view_write`` node so the whole
+        read-modify-write stays in one segment."""
+        eng = _engine_mod()
+        if type(value) is eng._Pending:
+            value.owners.append(weakref.ref(self))
         if self._base is None:
             self._data = value
             self._version += 1
-        else:
-            b = self._base
-            if type(b._data) is _engine_mod()._Pending:
-                b._data = _engine_mod().resolve(b._data)
-            size = int(np.prod(self._shape)) if self._shape else 1
+            return
+        b = self._base
+        newbase = eng.defer_view_write(self, value)
+        if newbase is None:
+            # non-deferrable write-through: any flush these resolves force
+            # is view fragmentation
+            if type(value) is eng._Pending:
+                value = eng.resolve(value, cause="view")
+            if type(b._data) is eng._Pending:
+                b._data = eng.resolve(b._data, cause="view")
             flat = b._data.reshape((-1,))
-            flat = jax.lax.dynamic_update_slice(flat, value.reshape((-1,)).astype(b._data.dtype),
-                                                (self._offset,))
-            b._data = flat.reshape(b._data.shape)
-            b._version += 1
-            self._data = value
-            self._cache_version = b._version
+            flat = jax.lax.dynamic_update_slice(
+                flat, value.reshape((-1,)).astype(b._data.dtype),
+                (self._offset,))
+            newbase = flat.reshape(b._data.shape)
+        b._data = newbase
+        b._version += 1
+        self._data = value
+        self._cache_version = b._version
 
     # -- basic properties --------------------------------------------------
     @property
@@ -313,6 +358,23 @@ class NDArray:
         raise TypeError("indexing with %r not supported" % (key,))
 
     def __setitem__(self, key, value):
+        if isinstance(key, slice) and key.start is None and key.stop is None and key.step is None:
+            # full-slice store: shape/dtype metadata suffices, so no read
+            # of self — a deferred target (or view over one) stays in the
+            # open bulk segment and the store records as a program node
+            dt = self.dtype
+            if isinstance(value, NDArray):
+                if value._shape == self._shape and np.dtype(value.dtype) == dt:
+                    self._write(value._read_deferred())
+                else:
+                    self._write(jnp.broadcast_to(value._read().astype(dt),
+                                                 self._shape))
+            elif isinstance(value, (int, float, bool, np.generic)):
+                self._write(jnp.full(self._shape, value, dt))
+            else:
+                self._write(jnp.broadcast_to(jnp.asarray(value).astype(dt),
+                                             self._shape))
+            return
         if isinstance(value, NDArray):
             val = value._read()
         elif isinstance(value, (int, float, bool, np.generic)):
@@ -320,13 +382,6 @@ class NDArray:
         else:
             val = jnp.asarray(value)
         cur = self._read()
-        if isinstance(key, slice) and key.start is None and key.stop is None and key.step is None:
-            if val is None:
-                new = jnp.full_like(cur, value)
-            else:
-                new = jnp.broadcast_to(val.astype(cur.dtype), cur.shape)
-            self._write(new)
-            return
         key2 = key
         if isinstance(key2, NDArray):
             key2 = key2._read().astype(jnp.int32)
@@ -458,7 +513,14 @@ class NDArray:
 
     def _inplace(self, other, op_name, scalar_op):
         res = self._binop(other, op_name, scalar_op)
-        self._write(res._read().astype(self.dtype))
+        if res._shape == self._shape \
+                and np.dtype(res.dtype) == np.dtype(self.dtype):
+            # may hand a _Pending to _write: the read-modify-write stays
+            # inside the open bulk segment (views write through as a
+            # recorded scatter node)
+            self._write(res._read_deferred())
+        else:
+            self._write(res._read().astype(self.dtype))
         return self
 
     def __iadd__(self, o):
@@ -609,11 +671,9 @@ def invoke(op: Operator, inputs, params, out=None):
                                     rec=recording, nd_inputs=inputs,
                                     out_reqs=out_reqs)
             if pend is not None:
-                import weakref
                 if write_plan is not None:
                     for slot, t in write_plan:
-                        t._write(pend[slot])
-                        pend[slot].owners.append(weakref.ref(t))
+                        t._write(pend[slot])   # registers t as owner
                     return touts[0] if len(touts) == 1 else touts
                 ctx = inputs[0]._ctx if inputs else current_context()
                 out_arrays = []

@@ -105,6 +105,29 @@ def _slice(data, begin=(), end=(), step=()):
     return data[idx]
 
 
+@register("_bulk_view_extract", num_inputs=1)
+def _bulk_view_extract(data, offset=0, shape=()):
+    """Contiguous row-major view extraction (engine deferred views): the
+    program-node form of NDArray._read over a (base, offset, shape) view,
+    recorded inside a bulk segment so view creation no longer flushes."""
+    flat = jnp.reshape(data, (-1,))
+    size = 1
+    for s in shape:
+        size *= s
+    return jnp.reshape(lax.slice(flat, (offset,), (offset + size,)), shape)
+
+
+@register("_bulk_view_write", num_inputs=2)
+def _bulk_view_write(base, value, offset=0):
+    """Write-through to a deferred view: rebind the base's buffer with the
+    view's span replaced (the program-node form of NDArray._write's
+    scatter into the base)."""
+    flat = jnp.reshape(base, (-1,))
+    flat = lax.dynamic_update_slice(
+        flat, jnp.reshape(value, (-1,)).astype(base.dtype), (offset,))
+    return jnp.reshape(flat, base.shape)
+
+
 @register("slice_axis", num_inputs=1)
 def _slice_axis(data, axis=0, begin=0, end=None):
     """ref: matrix_op.cc slice_axis"""

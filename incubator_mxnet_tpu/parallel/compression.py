@@ -138,7 +138,7 @@ def _rs_jitted(mesh, W, k, sum_dtype):
     key = (mesh, W, k, sum_dtype)
     fn = _rs_jit_cache.get(key)
     if fn is None:
-        from jax import shard_map
+        from .._jax_compat import shard_map
         from jax import lax
 
         def body(block):                       # (1, W*k) uint32

@@ -27,7 +27,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from .._jax_compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..gluon.block import HybridBlock

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+from .._jax_compat import shard_map
 
 __all__ = ["pipeline_apply", "pipeline_train_step", "make_pipeline_trainer",
            "PipelineTrainer"]

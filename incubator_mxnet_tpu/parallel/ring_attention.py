@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+from .._jax_compat import shard_map
 
 __all__ = ["ring_attention", "ring_attention_sharded"]
 

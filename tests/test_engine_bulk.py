@@ -100,9 +100,155 @@ def test_bulk_mixed_with_views():
     a = nd.array(np.arange(16, dtype=np.float32).reshape(4, 4))
     with engine.bulk(64):
         y = a * 2
-        v = y[1]           # view of a deferred value: materializes base
-        got = v.asnumpy()
+        v = y[1]           # view of a deferred value: defers (round 6)
+        got = v.asnumpy()  # host read is the only materialization point
     np.testing.assert_allclose(got, np.arange(4, 8, dtype=np.float32) * 2)
+
+
+def _view_chain(a, b, n=32):
+    """n compute ops with two interleaved views per round (reshape in,
+    reshape back) — the attention/im2col glue shape from the issue.  Op
+    pairs are chosen so XLA cannot FMA-contract across them (mul never
+    feeds add directly): bit-for-bit parity must hold between the fused
+    replay and per-op eager dispatch."""
+    x = a
+    for _ in range(n // 4):
+        x = x * b
+        x = x.reshape((4, 16))      # view 1
+        x = x.abs()
+        x = x.reshape((8, 8))       # view 2
+        x = x - 0.25
+        x = x / b
+    return x
+
+
+def test_bulk_view_chain_flushes_once():
+    """Tier-1 fragmentation guard: a 32-op chain with two interleaved
+    views per round under engine.bulk() must execute as ONE replay
+    program (flush-cause counters), bit-for-bit equal to unbulked eager
+    execution — view creation may never break the segment again."""
+    rs = np.random.RandomState(7)
+    a = nd.array(rs.rand(8, 8).astype(np.float32))
+    b = nd.array(rs.rand(8, 8).astype(np.float32) + 0.5)
+    want = _view_chain(a, b).asnumpy()
+    engine.reset_flush_stats()
+    with engine.bulk(128):
+        got = _view_chain(a, b)
+    g = got.asnumpy()
+    stats = engine.flush_stats()
+    assert stats["causes"]["scope-close"] == 1, stats
+    assert sum(stats["causes"].values()) == 1, \
+        "view chain fragmented: %r" % (stats,)
+    assert list(stats["segment_lengths"].values()) == [1], stats
+    np.testing.assert_array_equal(g, want)
+
+
+def test_bulk_slice_transpose_mid_chain_parity():
+    """reshape/slice/transpose mid-chain: bit-for-bit eager-vs-bulk
+    forward parity, one program."""
+    rs = np.random.RandomState(11)
+    av = rs.rand(6, 8).astype(np.float32)
+
+    def run(bulked):
+        import contextlib
+        a = nd.array(av)
+        scope = engine.bulk(64) if bulked else contextlib.nullcontext()
+        with scope:
+            x = a * 2.0
+            x = x.transpose((1, 0))     # (8,6) — registered op
+            x = x[2:6]                  # (4,6) — basic slice view
+            x = x.reshape((2, 12))      # view
+            x = x + 0.5
+            x = x.reshape((24,))        # view
+            out = (x * x).asnumpy()
+        return out
+
+    want = run(False)
+    engine.reset_flush_stats()
+    got = run(True)
+    stats = engine.flush_stats()
+    np.testing.assert_array_equal(got, want)
+    assert sum(stats["causes"].values()) == 1, stats
+
+
+def test_bulk_write_through_deferred_view():
+    """Write-through to a deferred view rebinds the base inside the same
+    program (lax.dynamic_update_slice node): full-slice store and +=
+    both stay deferred, and the base observes the write exactly as in
+    eager execution."""
+    def run(bulked):
+        import contextlib
+        y0 = nd.array(np.arange(16, dtype=np.float32).reshape(4, 4))
+        scope = engine.bulk(64) if bulked else contextlib.nullcontext()
+        with scope:
+            y = y0 * 2.0
+            v = y[1:3]          # deferred view
+            v[:] = 7.0          # write-through: scatter node, no flush
+            w = y.reshape((2, 8))
+            w += 1.0            # read-modify-write through a view
+            z = y + 0.0
+        return y.asnumpy(), z.asnumpy()
+
+    ye, ze = run(False)
+    engine.reset_flush_stats()
+    yb, zb = run(True)
+    stats = engine.flush_stats()
+    np.testing.assert_array_equal(ye, yb)
+    np.testing.assert_array_equal(ze, zb)
+    assert stats["causes"]["scope-close"] == 1, stats
+    assert sum(stats["causes"].values()) == 1, stats
+
+
+def test_bulk_recorded_view_segment_backward_parity():
+    """A recorded (autograd) segment carrying reshape/transpose/slice
+    keeps the one-tape-node contract: ONE flush (cause 'autograd'), and
+    the segment vjp flows through the view nodes with gradients
+    bit-identical to unbulked eager execution."""
+    import contextlib
+    rs = np.random.RandomState(0)
+    xv = rs.randn(4, 6).astype(np.float32)
+    wv = rs.randn(6, 8).astype(np.float32)
+
+    def step(bulked):
+        x = nd.array(xv)
+        w = nd.array(wv)
+        x.attach_grad()
+        w.attach_grad()
+        scope = engine.bulk(64) if bulked else contextlib.nullcontext()
+        with scope:
+            with autograd.record():
+                h = mx.nd.dot(x, w)          # (4,8)
+                h = h.reshape((8, 4))
+                h = h.transpose((1, 0))      # (4,8)
+                h = h[1:3]                   # (2,8)
+                loss = (h * h).sum()
+            loss.backward()
+        return (float(loss.asnumpy()), x.grad.asnumpy().copy(),
+                w.grad.asnumpy().copy())
+
+    l0, gx0, gw0 = step(False)
+    engine.reset_flush_stats()
+    l1, gx1, gw1 = step(True)
+    stats = engine.flush_stats()
+    assert l0 == l1
+    np.testing.assert_array_equal(gx0, gx1)
+    np.testing.assert_array_equal(gw0, gw1)
+    assert stats["causes"]["autograd"] == 1, stats
+    assert sum(stats["causes"].values()) == 1, stats
+
+
+def test_bulk_view_of_cross_scope_value_materializes():
+    """A view whose base pending belongs to a CLOSED segment cannot
+    defer: it materializes under the 'view' flush cause — the documented
+    fallback, not an error."""
+    a = nd.array(np.arange(8, dtype=np.float32))
+    with engine.bulk(4):
+        y = a * 2.0
+        with engine.bulk(4):       # inner scope: y is cross-scope
+            v = y.reshape((2, 4))
+            z = v + 1.0            # view read falls back, flushes outer
+            got = z.asnumpy()
+    np.testing.assert_allclose(got, np.arange(8).reshape(2, 4) * 2.0 + 1)
 
 
 def test_bulk_waitall_covers_replay():
