@@ -1,0 +1,19 @@
+"""Static analysis for the op registry and the bulking engine.
+
+Two cooperating passes (SURVEY §7: ONE registry serves eager, autograd
+and symbolic execution — so one malformed registration corrupts all
+three at once, and nothing checked the contracts until a user hit them):
+
+* ``contracts`` — the op-contract linter (pass 1): verifies every
+  registered Operator against its fcompute signature and AST.  CLI:
+  ``python -m incubator_mxnet_tpu.analysis.graftlint``.
+* ``engine_check`` — the strict-mode engine verifier (pass 2): hazard
+  structures raised by ``engine.py`` when ``GRAFT_ENGINE_CHECK=1``
+  (read/write version vectors per view group + the fusion-equivalence
+  oracle that replays each flushed segment unfused and bit-compares).
+
+Kept import-light on purpose: ``engine.py`` imports ``engine_check`` at
+module load, long before the ops package exists.
+"""
+
+__all__ = ["contracts", "engine_check", "graftlint"]

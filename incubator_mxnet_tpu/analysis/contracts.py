@@ -1,0 +1,469 @@
+"""Pass 1 — the op-contract linter.
+
+The registry's design bet (one registration serving eager, autograd and
+symbolic execution; registry.py, SURVEY §7) means every contract field is
+load-bearing three times over: ``num_inputs`` feeds the front-end arg
+binder AND the symbol executor, ``nograd_inputs`` drives both the eager
+tape skip and the segment-vjp input set (engine._rec_reachable_ext),
+``needs_rng``/``takes_is_train`` decide what kwargs dispatch injects.  A
+malformed registration therefore corrupts all three modes at once and
+nothing surfaced it until a user hit the broken path.
+
+This module verifies each registered ``Operator`` against its fcompute —
+the *signature* via inspect and the *body* via AST (``inspect.getsource``
++ ``ast.parse``, i.e. the ops/*.py sources themselves) — and reports
+``Diagnostic`` records with stable codes:
+
+=======  ==============================================================
+GL101    num_inputs disagrees with the fcompute positional arity
+         (incl. variadic ``num_inputs=None`` over a fixed-arity body)
+GL102    nograd_inputs index out of range
+GL103    mutate_inputs index out of range
+GL104    needs_rng promised but no ``rng`` kwarg (or the converse)
+GL105    takes_is_train promised but no ``is_train`` kwarg (or converse)
+GL106    input_names inconsistent with arity / positional names,
+         incl. the ``no_bias`` removal path in ``Operator.arg_names``
+GL107    registration collision: a name rebound to a different Operator
+GL108    impure fcompute: host-side calls (numpy on array inputs,
+         Python RNG, I/O) that break jax.jit AND shape inference —
+         ``jax.eval_shape`` runs the same function (no-FInferShape design)
+GL109    fcompute returns differing output counts but the registration
+         declares a fixed num_outputs and no fnum_outputs
+GL110    aux_input_names not a subset of input_names
+=======  ==============================================================
+
+Intentional deviations are silenced in-source::
+
+    # graftlint: disable=GL108 -- host callback op, impurity is the point
+    @register("my_op", ...)
+
+placed anywhere between the first decorator line and the ``def`` line
+(or on the line directly above the first decorator).
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import re
+import textwrap
+
+__all__ = ["Diagnostic", "RULES", "lint_operator", "lint_all",
+           "suppressions_for"]
+
+RULES = {
+    "GL101": "arity mismatch between num_inputs and the fcompute signature",
+    "GL102": "nograd_inputs index out of range",
+    "GL103": "mutate_inputs index out of range",
+    "GL104": "needs_rng contract broken (rng kwarg missing or undeclared)",
+    "GL105": "takes_is_train contract broken (is_train kwarg missing or "
+             "undeclared)",
+    "GL106": "input_names inconsistent with the fcompute arity/names",
+    "GL107": "registration collision: name rebound to a different Operator",
+    "GL108": "impure fcompute: host call that breaks jit/eval_shape",
+    "GL109": "divergent return arity without fnum_outputs",
+    "GL110": "aux_input_names not a subset of input_names",
+}
+
+# Call targets that are host-side by construction: executing one inside a
+# traced fcompute either crashes under jit or silently forks RNG state
+# off the reproducible key chain (random_ops.py header).
+_IMPURE_PREFIXES = (
+    ("np", "random"), ("numpy", "random"),
+    ("random",),                       # Python stdlib RNG module
+    ("time",),                         # wall-clock reads inside a trace
+    ("os", "environ"),
+)
+_IMPURE_BUILTINS = {"open", "print", "input"}
+
+
+class Diagnostic:
+    """One linter finding (machine-readable via :meth:`as_dict`)."""
+
+    __slots__ = ("code", "op_name", "message", "file", "line",
+                 "suppressed", "justification")
+
+    def __init__(self, code, op_name, message, file=None, line=None,
+                 suppressed=False, justification=None):
+        self.code = code
+        self.op_name = op_name
+        self.message = message
+        self.file = file
+        self.line = line
+        self.suppressed = suppressed
+        self.justification = justification
+
+    def as_dict(self):
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    def __repr__(self):
+        tag = " [suppressed]" if self.suppressed else ""
+        loc = "%s:%s" % (self.file, self.line) if self.file else "<builtin>"
+        return "%s %s (%s)%s: %s" % (self.code, self.op_name, loc, tag,
+                                     self.message)
+
+
+# ---------------------------------------------------------------------------
+# fcompute introspection
+# ---------------------------------------------------------------------------
+
+def _sig_info(fcompute):
+    """Positional-arity facts of an fcompute, or None when uninspectable.
+
+    ``pos_required_only`` counts required POSITIONAL-ONLY params — the
+    ones dispatch can never satisfy through the params dict (everything
+    POSITIONAL_OR_KEYWORD is keyword-bindable by ``Operator.bind``'s
+    ``functools.partial(fcompute, **params)``, so a required tunable like
+    count_sketch's ``out_dim`` is a valid contract, not an arity error)."""
+    try:
+        sig = inspect.signature(fcompute)
+    except (TypeError, ValueError):
+        return None
+    pos_required = pos_total = pos_required_only = 0
+    has_varargs = has_varkw = False
+    pos_names = []
+    kw_names = set()
+    for p in sig.parameters.values():
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            pos_total += 1
+            pos_names.append(p.name)
+            if p.default is inspect.Parameter.empty:
+                pos_required += 1
+                if p.kind is p.POSITIONAL_ONLY:
+                    pos_required_only += 1
+            if p.kind is p.POSITIONAL_OR_KEYWORD:
+                kw_names.add(p.name)
+        elif p.kind is p.VAR_POSITIONAL:
+            has_varargs = True
+        elif p.kind is p.KEYWORD_ONLY:
+            kw_names.add(p.name)
+        elif p.kind is p.VAR_KEYWORD:
+            has_varkw = True
+    return {"pos_required": pos_required, "pos_total": pos_total,
+            "pos_required_only": pos_required_only,
+            "pos_names": pos_names, "kw_names": kw_names,
+            "has_varargs": has_varargs, "has_varkw": has_varkw}
+
+
+def _fcompute_tree(fcompute):
+    """Top-level FunctionDef AST of the fcompute, or None (C callables,
+    REPL definitions, lambdas)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fcompute))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return None
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return node
+    return None
+
+
+def _toplevel_nodes(fn_node):
+    """Walk the function body, NOT descending into nested function/lambda
+    bodies — nested defs are closures (custom_vjp rules, host callbacks)
+    with their own execution context."""
+    stack = list(fn_node.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _dotted(node):
+    """A Call's target as a dotted-name tuple, e.g. np.random.rand ->
+    ('np', 'random', 'rand'); None for computed targets."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Z0-9, ]+?)\s*(?:--\s*(.*))?$")
+
+
+def suppressions_for(fcompute):
+    """{code: justification} declared in the registration's decorator
+    region: from the line above the first decorator down to the ``def``."""
+    code = getattr(fcompute, "__code__", None)
+    if code is None:
+        return {}
+    try:
+        with open(code.co_filename) as f:
+            lines = f.readlines()
+    except OSError:
+        return {}
+    start = max(code.co_firstlineno - 2, 0)   # one line above the decorator
+    out = {}
+    for i in range(start, min(start + 40, len(lines))):
+        m = _SUPPRESS_RE.search(lines[i])
+        if m:
+            why = (m.group(2) or "").strip() or None
+            for c in m.group(1).replace(" ", "").split(","):
+                if c:
+                    out[c] = why
+        if lines[i].lstrip().startswith("def ") and i >= code.co_firstlineno:
+            break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-rule checks
+# ---------------------------------------------------------------------------
+
+def _check_arity(op, sig):
+    n = op.num_inputs
+    if n is None:
+        if (not sig["has_varargs"] and sig["pos_total"] == sig["pos_required"]
+                and op.fargnames is None):
+            yield ("GL101", "num_inputs=None (variadic) but fcompute takes "
+                   "exactly %d positional arg(s) with no *args and no "
+                   "fargnames — the arity cannot actually vary"
+                   % sig["pos_total"])
+        return
+    if n < 0:
+        yield ("GL101", "num_inputs=%d is negative" % n)
+        return
+    if sig["pos_required_only"] > n:
+        # required POSITIONAL-ONLY params beyond the input count can never
+        # be fed: dispatch passes everything else through the params dict
+        # as keywords (Operator.bind), so a required POSITIONAL_OR_KEYWORD
+        # tunable (count_sketch's out_dim) is a valid contract
+        yield ("GL101", "fcompute requires %d positional-only args but "
+               "num_inputs=%d — dispatch can never satisfy the signature"
+               % (sig["pos_required_only"], n))
+    if not sig["has_varargs"] and n > sig["pos_total"]:
+        yield ("GL101", "num_inputs=%d exceeds the fcompute's %d positional "
+               "parameter(s) and it takes no *args"
+               % (n, sig["pos_total"]))
+
+
+def _index_bound(op, sig):
+    if isinstance(op.num_inputs, int):
+        return op.num_inputs
+    if sig is not None and not sig["has_varargs"]:
+        return sig["pos_total"]
+    return None   # true variadic: any index may be valid
+
+
+def _check_index_field(op, sig, field, code):
+    bound = _index_bound(op, sig)
+    for idx in getattr(op, field):
+        if not isinstance(idx, int) or idx < 0:
+            yield (code, "%s contains %r (indices must be non-negative "
+                   "ints)" % (field, idx))
+        elif bound is not None and idx >= bound:
+            yield (code, "%s index %d out of range for arity %d"
+                   % (field, idx, bound))
+
+
+def _check_rng(op, sig):
+    has = "rng" in sig["kw_names"] or sig["has_varkw"]
+    if op.needs_rng and not has:
+        yield ("GL104", "needs_rng=True but fcompute accepts no 'rng' "
+               "kwarg — dispatch injects rng= and the call explodes")
+    if not op.needs_rng and "rng" in sig["kw_names"]:
+        yield ("GL104", "fcompute has an 'rng' parameter but needs_rng is "
+               "not declared — the op never receives a key (rng stays at "
+               "its default)")
+
+
+def _check_is_train(op, sig):
+    has = "is_train" in sig["kw_names"] or sig["has_varkw"]
+    if op.takes_is_train and not has:
+        yield ("GL105", "takes_is_train=True but fcompute accepts no "
+               "'is_train' kwarg")
+    if not op.takes_is_train and "is_train" in sig["kw_names"]:
+        yield ("GL105", "fcompute has an 'is_train' parameter but "
+               "takes_is_train is not declared — train/eval mode never "
+               "reaches the op")
+
+
+def _check_input_names(op, sig):
+    names = op.input_names
+    if names is None:
+        return
+    names = list(names)
+    if isinstance(op.num_inputs, int) and len(names) != op.num_inputs:
+        yield ("GL106", "input_names lists %d name(s) but num_inputs=%d"
+               % (len(names), op.num_inputs))
+    if op.num_inputs is None and not sig["has_varargs"]:
+        if not (sig["pos_required"] <= len(names) <= sig["pos_total"]):
+            yield ("GL106", "input_names lists %d name(s) but the fcompute "
+                   "accepts %d..%d positional args"
+                   % (len(names), sig["pos_required"], sig["pos_total"]))
+    if not sig["has_varargs"] and sig["pos_names"]:
+        actual = sig["pos_names"][:len(names)]
+        if len(actual) == len(names) and actual != names:
+            yield ("GL106", "input_names %r do not match the fcompute's "
+                   "positional parameters %r — named binding (arg_names) "
+                   "and positional dispatch would disagree"
+                   % (names, actual))
+    if "bias" in names and "no_bias" not in sig["kw_names"]:
+        yield ("GL106", "input_names contains 'bias' but fcompute has no "
+               "'no_bias' param — Operator.arg_names' no_bias removal "
+               "path can never trigger")
+
+
+def _check_aux_names(op):
+    if not op.aux_input_names:
+        return
+    if op.input_names is None:
+        yield ("GL110", "aux_input_names declared but input_names is None "
+               "— aux positions cannot be located")
+        return
+    missing = [a for a in op.aux_input_names if a not in op.input_names]
+    if missing:
+        yield ("GL110", "aux_input_names %r missing from input_names"
+               % (missing,))
+
+
+def _check_purity(op, fn_node, sig):
+    if fn_node is None:
+        return
+    n_inputs = (op.num_inputs if isinstance(op.num_inputs, int)
+                else sig["pos_required"] if sig else 0)
+    input_names = set(sig["pos_names"][:n_inputs]) if sig else set()
+    for node in _toplevel_nodes(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if dotted is None:
+            continue
+        if len(dotted) == 1 and dotted[0] in _IMPURE_BUILTINS:
+            yield ("GL108", "host I/O call %s() at line %d breaks jit "
+                   "and eval_shape" % (dotted[0], node.lineno))
+            continue
+        for pre in _IMPURE_PREFIXES:
+            if dotted[:len(pre)] == pre and len(dotted) > len(pre):
+                yield ("GL108", "host-side call %s at line %d inside "
+                       "fcompute (non-reproducible under jit; breaks the "
+                       "no-FInferShape eval_shape design)"
+                       % (".".join(dotted), node.lineno))
+                break
+        else:
+            # numpy applied directly to an array INPUT (shape math over
+            # static params is fine; materializing a traced input is not)
+            if dotted[0] in ("np", "numpy") and any(
+                    isinstance(a, ast.Name) and a.id in input_names
+                    for a in node.args):
+                yield ("GL108", "numpy call %s at line %d consumes array "
+                       "input directly — materializes a tracer under jit"
+                       % (".".join(dotted), node.lineno))
+
+
+def _return_arities(fn_node):
+    """Known return lengths of the top-level body.  Unknowable returns are
+    skipped: calls, bare names (the variable may hold a tuple built
+    earlier), conditionals, starred, bare ``return``."""
+    known = set()
+    for node in _toplevel_nodes(fn_node):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        v = node.value
+        if isinstance(v, ast.Tuple):
+            if any(isinstance(e, ast.Starred) for e in v.elts):
+                continue
+            known.add(len(v.elts))
+        elif isinstance(v, (ast.Call, ast.IfExp, ast.Starred, ast.Name)):
+            continue
+        else:
+            known.add(1)
+    return known
+
+
+def _check_output_arity(op, fn_node):
+    if fn_node is None or op.fnum_outputs is not None:
+        return
+    known = _return_arities(fn_node)
+    if len(known) > 1:
+        yield ("GL109", "fcompute returns %s outputs depending on params "
+               "but registration declares fixed num_outputs=%d and no "
+               "fnum_outputs — symbolic executors mis-count outputs"
+               % (sorted(known), op.num_outputs))
+    elif known and known != {op.num_outputs}:
+        yield ("GL109", "fcompute visibly returns %d output(s) but "
+               "num_outputs=%d" % (known.pop(), op.num_outputs))
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def lint_operator(op):
+    """All diagnostics for one Operator (suppressions applied)."""
+    sig = _sig_info(op.fcompute)
+    fname, line = None, None
+    code = getattr(op.fcompute, "__code__", None)
+    if code is not None:
+        fname, line = code.co_filename, code.co_firstlineno
+    findings = []
+    if sig is not None:
+        for chk in (_check_arity(op, sig),
+                    _check_index_field(op, sig, "nograd_inputs", "GL102"),
+                    _check_index_field(op, sig, "mutate_inputs", "GL103"),
+                    _check_rng(op, sig),
+                    _check_is_train(op, sig),
+                    _check_input_names(op, sig)):
+            findings.extend(chk)
+    findings.extend(_check_aux_names(op))
+    fn_node = _fcompute_tree(op.fcompute)
+    findings.extend(_check_purity(op, fn_node, sig))
+    findings.extend(_check_output_arity(op, fn_node))
+    sup = suppressions_for(op.fcompute)
+    return [Diagnostic(c, op.name, msg, fname, line,
+                       suppressed=c in sup, justification=sup.get(c))
+            for c, msg in findings]
+
+
+def _collision_diagnostics(log, names=None):
+    for entry in log:
+        prev = entry["collided_with"]
+        if prev is None:
+            continue
+        if names is not None and entry["name"] not in names:
+            continue
+        op = entry["op"]
+        msg = ("name %r rebound from Operator(%s) to Operator(%s)%s — the "
+               "registry keeps only the last binding, silently"
+               % (entry["name"], prev.name, op.name,
+                  " (alias of %s)" % entry["alias_of"]
+                  if entry["alias_of"] else ""))
+        sup = suppressions_for(op.fcompute)
+        yield Diagnostic("GL107", entry["name"], msg,
+                         entry["file"], entry["line"],
+                         suppressed="GL107" in sup,
+                         justification=sup.get("GL107"))
+
+
+def lint_all(names=None):
+    """Lint the live registry (+ the registration log for collisions).
+
+    ``names``: optional container of op/alias names to restrict to —
+    used by fixture tests to lint only their deliberately-broken ops.
+    Importing the ops package is the caller's job (graftlint CLI does it).
+    """
+    from ..ops.registry import _REGISTRY, registration_log
+    diags = []
+    seen = set()
+    for name in sorted(_REGISTRY):
+        if names is not None and name not in names:
+            continue
+        op = _REGISTRY[name]
+        if id(op) in seen:
+            continue
+        seen.add(id(op))
+        diags.extend(lint_operator(op))
+    diags.extend(_collision_diagnostics(registration_log(), names))
+    return diags

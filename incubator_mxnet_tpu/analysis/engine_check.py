@@ -1,0 +1,172 @@
+"""Pass 2 — strict-mode engine hazard verification (GRAFT_ENGINE_CHECK=1).
+
+PR 1 made views first-class citizens of bulk segments: a view over a
+deferred base records a ``_bulk_view_extract`` program node, write-through
+records a ``_bulk_view_write`` that REBINDS the base — so a segment is a
+little dataflow program over mutable ownership groups, and the classic
+engine hazards (write-after-read against a stale extract, lost-update
+double rebinds) exist in miniature.  The production paths guard them with
+version counters (NDArray._version / _cache_version), but nothing
+*verified* the guards: a bug was caught only if a parity test happened to
+cover it ("Memory Safe Computations with XLA Compiler", PAPERS.md, makes
+the case for verifying these statically/structurally instead).
+
+This module holds the structured error plus the pure check functions;
+``engine.py`` calls them at record and flush time when strict mode is on.
+The checks:
+
+=======  ==============================================================
+EH101    stale-extract read (write-after-read): an instruction consumes
+         a ``_bulk_view_extract`` pending whose base version advanced
+         after the extract was recorded — fused replay would ship the
+         pre-write value where eager execution reads the post-write one
+EH102    double-write rebind (lost update): a ``_bulk_view_write``
+         whose base operand is no longer the base's current binding —
+         the write would silently discard every rebind in between
+EH103    segment-integrity / escaped external: an instruction operand
+         that resolves outside the segment's ``ext`` set (out-of-range
+         ext slot, forward temp reference) or an ext slot no
+         instruction consumes (orphan entries corrupt the replay-cache
+         key — see engine.maybe_defer's staging comment)
+EH104    fusion divergence: the jitted (fused) segment replay and the
+         op-by-op (unfused) replay disagree at the bit level — the
+         fusion-equivalence oracle ("Operator Fusion in XLA: Analysis
+         and Evaluation" motivates checking fused vs unfused semantics).
+         Integer/bool outputs must match exactly; float outputs may
+         differ by at most GRAFT_ENGINE_CHECK_ULPS (default 8) units in
+         the last place PER RECORDED INSTRUCTION, because XLA fusion
+         legitimately re-rounds an elementwise chain by ~1 ULP per op —
+         a genuine hazard (stale value, lost update, wrong operand)
+         sits millions of ULPs away
+=======  ==============================================================
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["EngineHazardError", "check_segment_integrity", "oracle_compare",
+           "HAZARDS"]
+
+HAZARDS = {
+    "EH101": "stale-extract read (write-after-read hazard)",
+    "EH102": "double-write rebind (lost-update hazard)",
+    "EH103": "segment integrity violation / escaped external",
+    "EH104": "fused/unfused replay divergence (fusion-equivalence oracle)",
+}
+
+
+class EngineHazardError(RuntimeError):
+    """Structured engine hazard: ``code`` is one of HAZARDS, ``detail``
+    carries the per-hazard specifics for programmatic triage."""
+
+    def __init__(self, code, message, **detail):
+        super().__init__("%s [%s]: %s" % (code, HAZARDS.get(code, "?"),
+                                          message))
+        self.code = code
+        self.detail = detail
+
+
+def check_segment_integrity(instrs, n_ext):
+    """EH103: validate every operand reference of a recorded segment.
+
+    ``instrs`` are engine instruction tuples (op_name, params, pkey,
+    is_train, in_refs, rng_slot, n_out, rec); ``n_ext`` the ext count.
+    """
+    produced = 0
+    used_ext = set()
+    for k, (name, _p, _k, _t, in_refs, rng_slot, n_out, _rec) in \
+            enumerate(instrs):
+        for tag, i in in_refs:
+            if tag == "e":
+                if not 0 <= i < n_ext:
+                    raise EngineHazardError(
+                        "EH103", "instruction #%d (%s) reads ext slot %d "
+                        "but the segment holds %d external operand(s) — "
+                        "a value escaped the ext set" % (k, name, i, n_ext),
+                        instruction=k, op=name, slot=i)
+                used_ext.add(i)
+            else:
+                if not 0 <= i < produced:
+                    raise EngineHazardError(
+                        "EH103", "instruction #%d (%s) reads temp slot %d "
+                        "before it is produced (%d temps exist at that "
+                        "point)" % (k, name, i, produced),
+                        instruction=k, op=name, slot=i)
+        if rng_slot is not None:
+            if not 0 <= rng_slot < n_ext:
+                raise EngineHazardError(
+                    "EH103", "instruction #%d (%s) reads rng ext slot %d "
+                    "out of range %d" % (k, name, rng_slot, n_ext),
+                    instruction=k, op=name, slot=rng_slot)
+            used_ext.add(rng_slot)
+        produced += n_out
+    orphans = sorted(set(range(n_ext)) - used_ext)
+    if orphans:
+        raise EngineHazardError(
+            "EH103", "ext slot(s) %s are referenced by no instruction — "
+            "orphan operands pollute the replay-cache key and pin dead "
+            "buffers" % (orphans,), orphans=orphans)
+
+
+def _ulp_tolerance():
+    try:
+        return int(os.environ.get("GRAFT_ENGINE_CHECK_ULPS", "8"))
+    except ValueError:
+        return 8
+
+
+def _ordered_float_bits(a):
+    """Float bit patterns mapped to monotonically increasing UNSIGNED ints
+    (the classic total-order transform: negatives are bit-inverted,
+    positives get the sign bit set) — works for f16/bf16/f32/f64 since it
+    only needs the IEEE sign-magnitude layout.  Staying unsigned avoids
+    the int64 wrap a cast would cause for f64 sign-bit patterns."""
+    u = np.ascontiguousarray(a).view("u%d" % a.dtype.itemsize)
+    sign = np.array(1, dtype=u.dtype) << (8 * a.dtype.itemsize - 1)
+    return np.where(u & sign, ~u, u | sign)
+
+
+def _max_ulp_distance(fa, ua):
+    """Max ULP distance between two same-dtype float arrays, or None when
+    they differ structurally (NaN pattern mismatch).  ±0 count as 1 ULP
+    apart; equal-position NaNs (any payload) count as 0."""
+    fnan, unan = np.isnan(fa), np.isnan(ua)
+    if not np.array_equal(fnan, unan):
+        return None
+    of = _ordered_float_bits(fa)
+    ou = _ordered_float_bits(ua)
+    dist = np.maximum(of, ou) - np.minimum(of, ou)   # exact, unsigned
+    dist[fnan.reshape(dist.shape)] = 0
+    return int(dist.max()) if dist.size else 0
+
+
+def oracle_compare(fused, unfused, instrs, live):
+    """EH104: compare the jitted segment replay against the op-by-op
+    (unfused) replay of the same program over the same operands, at the
+    bit level (float outputs get the documented small ULP allowance for
+    fusion re-rounding; everything else must match exactly)."""
+    tol = _ulp_tolerance() * max(1, len(instrs))
+    for pos, (f, u) in enumerate(zip(fused, unfused)):
+        fa, ua = np.asarray(f), np.asarray(u)
+        if fa.dtype == ua.dtype and fa.shape == ua.shape \
+                and fa.tobytes() == ua.tobytes():
+            continue
+        ulps = None
+        is_float = (fa.dtype.kind == "f"
+                    or fa.dtype.name.startswith(("bfloat", "float8")))
+        if fa.dtype == ua.dtype and fa.shape == ua.shape and is_float \
+                and fa.dtype.itemsize in (1, 2, 4, 8):
+            ulps = _max_ulp_distance(fa, ua)
+            if ulps is not None and ulps <= tol:
+                continue
+        raise EngineHazardError(
+            "EH104", "fused and unfused replay disagree on live output "
+            "#%d (shape %s/%s dtype %s/%s, %s) over segment %s"
+            % (pos, fa.shape, ua.shape, fa.dtype, ua.dtype,
+               "max %s ULPs > tolerance %d" % (ulps, tol)
+               if ulps is not None else "structural mismatch",
+               [i[0] for i in instrs]),
+            output=pos, max_ulps=ulps, tolerance=tol, live=list(live),
+            ops=[i[0] for i in instrs])

@@ -1,0 +1,124 @@
+"""graftlint — CLI for the op-contract linter.
+
+Usage::
+
+    python -m incubator_mxnet_tpu.analysis.graftlint [--all] [--json]
+           [--ops NAME[,NAME...]] [--list-rules]
+
+Imports the full ops package (registration side effects populate the
+registry and the registration log), runs every contract rule, and exits
+non-zero on unsuppressed findings.  ``--json`` emits the machine-readable
+report to stdout, ``--report PATH`` writes it to a file alongside the
+human summary (one linter pass serves both), and ``--contracts`` dumps
+every registered op's machine-readable contract (Operator.contract()).
+
+Linting is platform-independent, so the CLI pins jax to CPU before the
+ops import — the axon sitecustomize otherwise force-selects the TPU
+platform and a lint run would die at backend init (or crawl through the
+tunnel) on a box without an attached TPU.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _force_cpu_platform():
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass   # backend already initialized (in-process callers): lint
+        #        works on whatever platform the host chose
+
+
+def _report_json(diags):
+    active = [d for d in diags if not d.suppressed]
+    counts = {}
+    for d in active:
+        counts[d.code] = counts.get(d.code, 0) + 1
+    return {
+        "version": 1,
+        "total": len(active),
+        "suppressed": sum(1 for d in diags if d.suppressed),
+        "counts": counts,
+        "diagnostics": [d.as_dict() for d in diags],
+    }
+
+
+def main(argv=None):
+    from . import contracts
+
+    ap = argparse.ArgumentParser(
+        prog="graftlint", description="op-contract static analyzer")
+    ap.add_argument("--all", action="store_true",
+                    help="lint every registered op (default when no --ops)")
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated op names to lint")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable JSON report on stdout")
+    ap.add_argument("--report", default=None, metavar="PATH",
+                    help="also write the JSON report to PATH (single pass)")
+    ap.add_argument("--contracts", action="store_true",
+                    help="dump every op's machine-readable contract as "
+                         "JSON and exit")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the diagnostic codes and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for code in sorted(contracts.RULES):
+            print("%s  %s" % (code, contracts.RULES[code]))
+        return 0
+
+    _force_cpu_platform()
+    # registration side effects; engine hazards (pass 2) live at runtime
+    # behind GRAFT_ENGINE_CHECK=1, not here
+    import incubator_mxnet_tpu.ops  # noqa: F401
+    import incubator_mxnet_tpu.operator  # noqa: F401  custom-op registry
+
+    names = None
+    if args.ops:
+        names = {n for n in args.ops.split(",") if n}
+
+    if args.contracts:
+        from ..ops.registry import _REGISTRY
+        out = {n: op.contract() for n, op in sorted(_REGISTRY.items())
+               if names is None or n in names}
+        print(json.dumps(out, indent=2, default=str))
+        return 0
+
+    diags = contracts.lint_all(names=names)
+    active = [d for d in diags if not d.suppressed]
+    report = _report_json(diags)
+
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        for d in diags:
+            print(repr(d))
+        print("graftlint: %d finding(s), %d suppressed, %d op name(s) "
+              "checked" % (len(active),
+                           sum(1 for d in diags if d.suppressed),
+                           len(names) if names is not None else
+                           _registry_size()))
+        if args.report:
+            print("graftlint: JSON report at %s" % args.report)
+    return 1 if active else 0
+
+
+def _registry_size():
+    from ..ops.registry import _REGISTRY
+    return len(_REGISTRY)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

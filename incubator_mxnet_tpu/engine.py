@@ -57,6 +57,17 @@ Out of scope for deferral (dispatched eagerly, exactly as before):
 recorded ops with ``out=``, sparse storage, ops that manage their own
 mesh placement (no_jit), and NaiveEngine mode.
 
+Strict mode (round 7, ``GRAFT_ENGINE_CHECK=1`` or ``set_engine_check``):
+every segment is verified against the hazards the deferral machinery
+could silently mis-handle — a read/write version vector per base+view
+ownership group catches stale-extract write-after-read (EH101) and
+double-write rebinds (EH102) at record time; flush validates operand
+references against the ``ext`` set (EH103) and replays the segment
+UNFUSED, bit-comparing every live output against the fused result (the
+fusion-equivalence oracle, EH104).  Violations raise structured
+``EngineHazardError``s (analysis/engine_check.py; docs/static_analysis.md).
+Debug-only: the oracle doubles execution per flush.
+
 Every flush is attributed to a cause — ``scope-close`` (bulk.__exit__),
 ``size-cap`` (segment hit ``size``), ``view`` (a non-deferrable view
 materialized its base), ``read`` (asnumpy/_read of a deferred value),
@@ -67,13 +78,37 @@ both so segment fragmentation is visible per round.
 """
 from __future__ import annotations
 
+import os
 import threading
 import weakref
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["bulk", "flush", "flush_stats", "reset_flush_stats"]
+from .analysis.engine_check import (EngineHazardError,
+                                    check_segment_integrity, oracle_compare)
+
+__all__ = ["bulk", "flush", "flush_stats", "reset_flush_stats",
+           "EngineHazardError", "engine_check_enabled", "set_engine_check"]
+
+
+# --- strict-mode switch (GRAFT_ENGINE_CHECK=1) -----------------------------
+# Read per bulk-scope entry (not at import) so tests and debug sessions can
+# toggle it without reimporting; set_engine_check overrides the env var.
+_engine_check_override = None
+
+
+def set_engine_check(flag):
+    """Force strict mode on/off (None = defer to GRAFT_ENGINE_CHECK)."""
+    global _engine_check_override
+    _engine_check_override = flag
+
+
+def engine_check_enabled():
+    if _engine_check_override is not None:
+        return bool(_engine_check_override)
+    return os.environ.get("GRAFT_ENGINE_CHECK", "").strip().lower() \
+        in ("1", "true", "yes", "on")
 
 
 class _Pending(object):
@@ -109,8 +144,15 @@ class _Pending(object):
 
 
 class _BulkState(object):
-    def __init__(self, size):
+    def __init__(self, size, check=False):
         self.size = size
+        self.check = bool(check)  # strict-mode verifier (GRAFT_ENGINE_CHECK)
+        self.extract_meta = {}   # id(extract _Pending) -> (view weakref,
+        #                          base weakref, base._version at record):
+        #                          the read side of the strict-mode
+        #                          version vector (writes bump
+        #                          NDArray._version, so staleness is
+        #                          recorded-version != current-version)
         self.epoch = 0           # bumped per flush: "t" refs are only
         #                          valid within their own segment
         self.instructions = []   # (op_name, params, pkey, is_train,
@@ -181,7 +223,7 @@ class bulk(object):
 
     def __enter__(self):
         self._prev = _current()
-        _tls.state = _BulkState(self.size)
+        _tls.state = _BulkState(self.size, check=engine_check_enabled())
         return self
 
     def __exit__(self, *exc):
@@ -226,6 +268,8 @@ def maybe_defer(op, params, vals, is_train, kw, rec=False, nd_inputs=None,
             owner = nd_inputs[i] if nd_inputs is not None else None
             staged.append(("e", v, owner))
         shapes.append((tuple(v.shape), str(v.dtype)))
+    if st.check:
+        _strict_check_record(st, op, vals, nd_inputs)
     pkey = _hashable(params)
     ikey = (op.name, tuple(shapes), pkey, bool(is_train))
     out_sig = _infer_cache.get(ikey)
@@ -257,6 +301,54 @@ def maybe_defer(op, params, vals, is_train, kw, rec=False, nd_inputs=None,
     return tuple(outs)
 
 
+def _strict_check_record(st, op, vals, nd_inputs):
+    """Record-time hazard checks (GRAFT_ENGINE_CHECK=1): consult the
+    read/write version vector of each input's base+view ownership group.
+    Reads are the extract_meta entries stamped by defer_view_read; writes
+    are NDArray._version bumps — staleness is a version mismatch."""
+    for pos, v in enumerate(vals):
+        if type(v) is not _Pending:
+            continue
+        meta = st.extract_meta.get(id(v))
+        if meta is None:
+            continue
+        view_ref, base_ref, ver = meta
+        base = base_ref()
+        view = view_ref()
+        # Staleness is only hazardous when the pending arrives THROUGH
+        # the view it extracts: eager semantics would re-read the view
+        # post-write there (_read_deferred re-extracts, so a stale
+        # arrival means that guard was bypassed).  Reaching the same
+        # pending through a different owner — e.g. `w[:] = v` stored the
+        # extract into a copy target — is a legal snapshot read of the
+        # pre-write value, exactly what the recorded program replays.
+        consumer = (nd_inputs[pos] if nd_inputs is not None
+                    and pos < len(nd_inputs) else None)
+        if base is not None and view is not None and consumer is view \
+                and base._version != ver:
+            raise EngineHazardError(
+                "EH101", "op %r consumes view (shape %s offset %d) "
+                "through a _bulk_view_extract recorded at base version %d "
+                "but the base has been rebound to version %d since — the "
+                "fused replay would read the pre-write value where eager "
+                "execution reads the post-write one" % (
+                    op.name, view._shape, view._offset, ver, base._version),
+                op=op.name, input=pos, recorded_version=ver,
+                current_version=base._version,
+                group_views=len(base._live_views()))
+    if op.name == "_bulk_view_write" and nd_inputs:
+        base = nd_inputs[0]
+        if base is not None and vals and base._data is not vals[0]:
+            raise EngineHazardError(
+                "EH102", "_bulk_view_write over a base operand that is no "
+                "longer the base's current binding (version %d) — the "
+                "rebind would silently discard intervening write(s) "
+                "(lost update); ownership group has %d live view(s)"
+                % (base._version, len(base._live_views())),
+                base_version=base._version,
+                group_views=len(base._live_views()))
+
+
 def defer_view_read(view):
     """Record a ``_bulk_view_extract`` node for a (base, offset, shape)
     view whose base is deferred: the view's value becomes a new _Pending
@@ -285,6 +377,11 @@ def defer_view_read(view):
         return None
     p = pend[0]
     p.owners.append(weakref.ref(view))
+    if st.check:
+        # read-side entry of the strict-mode version vector: this extract
+        # is valid exactly while the base stays at its current version
+        st.extract_meta[id(p)] = (weakref.ref(view), weakref.ref(base),
+                                  base._version)
     return p
 
 
@@ -477,7 +574,21 @@ def flush(state=None, cause="read"):
     st.ext_owners = []
     st.ext_pins = []
     st.any_recorded = False
+    st.extract_meta = {}
     st.epoch += 1
+
+    if st.check:
+        # EH103 — validate operand references AFTER the state reset, so a
+        # hazard raised here leaves the scope reusable (the scope-close
+        # flush sees an empty program instead of re-raising); stamp the
+        # hazard on every pending so later reads surface IT, not the
+        # misleading liveness invariant error
+        try:
+            check_segment_integrity(instrs, len(ext))
+        except EngineHazardError as exc:
+            for p in pendings:
+                p.error = exc
+            raise
 
     # only values still EXPOSED through a live NDArray leave the program:
     # the owner must not just be alive, its buffer must still be this
@@ -507,6 +618,12 @@ def flush(state=None, cause="read"):
     fn, replay = entry
     try:
         results = fn(ext)
+        if st.check and results:
+            # EH104 — the fusion-equivalence oracle: replay the segment
+            # UNFUSED (the same replay closure outside jit dispatches each
+            # op eagerly) and bit-compare every live output.  Costs a full
+            # second execution per flush; debug-only by construction.
+            oracle_compare(results, replay(ext), instrs, live)
     except Exception as exc:
         # stamp every pending with the real cause: later reads raise THIS
         # instead of a misleading liveness error

@@ -67,6 +67,7 @@ class NDArray:
             self._shape = tuple(shape)
             self._data = None
             self._cache_version = -1
+            base._register_view(self)
         else:
             self._base = None
             self._offset = 0
@@ -82,6 +83,41 @@ class NDArray:
     # -- storage access ----------------------------------------------------
     def _root(self):
         return self._base if self._base is not None else self
+
+    # -- view-group bookkeeping --------------------------------------------
+    # Every root tracks weakrefs to the views cut from it, so base+views
+    # form an inspectable OWNERSHIP GROUP: the strict-mode engine verifier
+    # (GRAFT_ENGINE_CHECK=1, engine.py) walks the group to report which
+    # sibling extracts a hazardous rebind invalidated, and liveness
+    # debugging can enumerate who still exposes a buffer.  A plain list of
+    # weakrefs, NOT a WeakSet: NDArray.__eq__ is elementwise broadcast, so
+    # any hash-bucket collision inside a WeakSet would try to truth-test
+    # an array.
+    def _register_view(self, view):
+        views = getattr(self, "_views", None)
+        if views is None:
+            views = self._views = []
+        views.append(weakref.ref(view))
+        # amortized O(1) on the hot __getitem__/reshape path: compact the
+        # dead refs only once the list doubles past the last compaction
+        if len(views) >= getattr(self, "_views_compact_at", 32):
+            views[:] = [w for w in views if w() is not None]
+            self._views_compact_at = max(32, 2 * len(views))
+
+    def _live_views(self):
+        """Live view NDArrays cut from this root (empty for views)."""
+        views = getattr(self, "_views", None)
+        if not views:
+            return ()
+        alive = [w() for w in views]
+        views[:] = [w for w, v in zip(views, alive) if v is not None]
+        return tuple(v for v in alive if v is not None)
+
+    def _view_group(self):
+        """(root, live views of that root) — the ownership group this
+        array belongs to, whichever side of the base/view split it is."""
+        root = self._root()
+        return root, root._live_views()
 
     def _read(self, cause="read"):
         """Current jax.Array value (no host sync).  ``cause`` labels any

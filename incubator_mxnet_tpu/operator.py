@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from .base import MXNetError
-from .ops.registry import Operator, _REGISTRY
+from .ops.registry import Operator, _REGISTRY, _log_registration
 
 __all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered_operators"]
 
@@ -192,6 +192,7 @@ def register(reg_name):
             raise TypeError("register must wrap a CustomOpProp subclass")
         _CUSTOM_PROPS[reg_name] = prop_cls
         op = _make_custom_operator(reg_name, prop_cls)
+        _log_registration(op.name, op)
         _REGISTRY[op.name] = op
         return prop_cls
 

@@ -50,6 +50,7 @@ def _sparse_sgd_update(weight, grad_data, grad_indices, lr=0.01, wd=0.0,
 
 
 @register("_sparse_sgd_mom_update", num_inputs=4, differentiable=False,
+          num_outputs=2, num_visible_outputs=1,
           mutate_inputs=(0, 3))
 def _sparse_sgd_mom_update(weight, grad_data, grad_indices, mom, lr=0.01,
                            momentum=0.0, wd=0.0, rescale_grad=1.0,
@@ -68,7 +69,8 @@ def _sparse_sgd_mom_update(weight, grad_data, grad_indices, mom, lr=0.01,
             mom.at[idx].set(new_rows_m))
 
 
-@register("sgd_mom_update", num_inputs=3, differentiable=False, mutate_inputs=(0, 2))
+@register("sgd_mom_update", num_inputs=3, differentiable=False, num_outputs=2, num_visible_outputs=1,
+          mutate_inputs=(0, 2))
 def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                     rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
     """ref: optimizer_op.cc sgd_mom_update: mom = m*mom - lr*g; w += mom"""
@@ -77,7 +79,8 @@ def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
     return weight + new_mom, new_mom
 
 
-@register("nag_mom_update", num_inputs=3, differentiable=False, mutate_inputs=(0, 2))
+@register("nag_mom_update", num_inputs=3, differentiable=False, num_outputs=2, num_visible_outputs=1,
+          mutate_inputs=(0, 2))
 def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                     rescale_grad=1.0, clip_gradient=-1.0):
     """Nesterov momentum (ref: optimizer.py NAG python updater)."""
@@ -86,7 +89,8 @@ def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
     return weight - lr * (g + momentum * new_mom), new_mom
 
 
-@register("mp_sgd_update", num_inputs=3, differentiable=False, mutate_inputs=(0, 2))
+@register("mp_sgd_update", num_inputs=3, differentiable=False, num_outputs=2, num_visible_outputs=1,
+          mutate_inputs=(0, 2))
 def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0, lazy_update=True):
     """ref: optimizer_op.cc mp_sgd_update — update in f32, cast to w.dtype."""
@@ -95,7 +99,8 @@ def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
     return new_w32.astype(weight.dtype), new_w32
 
 
-@register("mp_sgd_mom_update", num_inputs=4, differentiable=False, mutate_inputs=(0, 2, 3))
+@register("mp_sgd_mom_update", num_inputs=4, differentiable=False, num_outputs=3, num_visible_outputs=1,
+          mutate_inputs=(0, 2, 3))
 def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0, wd=0.0,
                        rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
     g32 = _prep_grad(grad.astype(jnp.float32), wd, weight32, rescale_grad, clip_gradient)
@@ -104,7 +109,8 @@ def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0, wd=0.
     return new_w32.astype(weight.dtype), new_mom, new_w32
 
 
-@register("adam_update", num_inputs=4, differentiable=False, mutate_inputs=(0, 2, 3))
+@register("adam_update", num_inputs=4, differentiable=False, num_outputs=3, num_visible_outputs=1,
+          mutate_inputs=(0, 2, 3))
 def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                  lazy_update=True):
@@ -117,7 +123,8 @@ def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
     return new_w, new_mean, new_var
 
 
-@register("rmsprop_update", num_inputs=3, differentiable=False, mutate_inputs=(0, 2))
+@register("rmsprop_update", num_inputs=3, differentiable=False, num_outputs=2, num_visible_outputs=1,
+          mutate_inputs=(0, 2))
 def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8, wd=0.0,
                     rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
     """ref: optimizer_op.cc rmsprop_update"""
@@ -130,6 +137,7 @@ def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8, wd=0.0
 
 
 @register("rmspropalex_update", num_inputs=5, differentiable=False,
+          num_outputs=4, num_visible_outputs=1,
           mutate_inputs=(0, 2, 3, 4))
 def _rmspropalex_update(weight, grad, n, g, delta, lr=0.001, gamma1=0.95, gamma2=0.9,
                         epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
@@ -145,7 +153,8 @@ def _rmspropalex_update(weight, grad, n, g, delta, lr=0.001, gamma1=0.95, gamma2
     return new_w, new_n, new_g, new_delta
 
 
-@register("ftrl_update", num_inputs=4, differentiable=False, mutate_inputs=(0, 2, 3))
+@register("ftrl_update", num_inputs=4, differentiable=False, num_outputs=3, num_visible_outputs=1,
+          mutate_inputs=(0, 2, 3))
 def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
                  rescale_grad=1.0, clip_gradient=-1.0):
     """ref: optimizer_op.cc ftrl_update"""
@@ -162,7 +171,8 @@ def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
     return new_w, new_z, new_n
 
 
-@register("ftml_update", num_inputs=5, differentiable=False, mutate_inputs=(0, 2, 3, 4))
+@register("ftml_update", num_inputs=5, differentiable=False, num_outputs=4, num_visible_outputs=1,
+          mutate_inputs=(0, 2, 3, 4))
 def _ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999,
                  epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
     """ref: src/operator/optimizer_op.cc ftml_update (FTML, Zheng 2017)."""
@@ -186,7 +196,8 @@ def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradie
     return weight - lr * (jnp.sign(g) + wd * weight)
 
 
-@register("signum_update", num_inputs=3, differentiable=False, mutate_inputs=(0, 2))
+@register("signum_update", num_inputs=3, differentiable=False, num_outputs=2, num_visible_outputs=1,
+          mutate_inputs=(0, 2))
 def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
     """ref: optimizer_op.cc signum_update"""

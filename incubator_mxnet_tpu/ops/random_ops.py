@@ -66,6 +66,7 @@ def _gen_negative_binomial(mu=1.0, alpha=1.0, shape=(), dtype="float32", ctx=Non
 
 
 @register("_sample_multinomial", num_inputs=1, differentiable=False, needs_rng=True,
+          fnum_outputs=lambda p: 2 if p.get("get_prob") else 1,
           aliases=("sample_multinomial",))
 def _multinomial(data, shape=(), get_prob=False, dtype="int32", rng=None):
     """ref: src/operator/random/multisample_op.cc — sample class ids from

@@ -25,13 +25,46 @@ Differences from the reference, by design (SURVEY §7):
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import Callable, Optional
 
 import jax
 
-__all__ = ["Operator", "register", "get_op", "list_ops", "alias"]
+__all__ = ["Operator", "register", "get_op", "list_ops", "alias",
+           "registration_log"]
 
 _REGISTRY: dict[str, "Operator"] = {}
+
+# Every register()/alias() call appends one entry here so static analysis
+# (analysis/graftlint) can see registration ORDER and collisions — the
+# dict alone silently keeps only the last binding per name.  Entries:
+# {"name", "op", "alias_of" (canonical name or None), "file", "line",
+#  "collided_with" (the Operator this binding displaced, or None)}.
+_REGISTRATION_LOG: list[dict] = []
+
+
+def _source_of(fcompute):
+    """(file, line) of an fcompute, or (None, None) for C callables."""
+    code = getattr(fcompute, "__code__", None)
+    if code is None:
+        return None, None
+    return code.co_filename, code.co_firstlineno
+
+
+def _log_registration(name, op, alias_of=None):
+    prev = _REGISTRY.get(name)
+    fname, line = _source_of(op.fcompute)
+    _REGISTRATION_LOG.append({
+        "name": name, "op": op, "alias_of": alias_of,
+        "file": fname, "line": line,
+        "collided_with": prev if (prev is not None and prev is not op)
+        else None,
+    })
+
+
+def registration_log():
+    """The append-only log of every registration (canonical + alias)."""
+    return list(_REGISTRATION_LOG)
 
 
 def _hashable(v):
@@ -89,6 +122,24 @@ class Operator:
         # them to the default device and clash with the op's mesh
         self.doc = doc
         self._jit_cache: dict = {}
+        # Populated EAGERLY so registry introspection (graftlint, symbol
+        # executors) never mutates Operator instances mid-flight — the
+        # lazy first-call cache made concurrent readers race on attribute
+        # creation and made linting observable as a state change.  The
+        # __defaults__ fast path keeps default-free throwaway Operators
+        # (the per-flush _BulkSegment lambda, engine.py) off
+        # inspect.signature entirely.
+        if getattr(fcompute, "__defaults__", None) \
+                or getattr(fcompute, "__kwdefaults__", None):
+            try:
+                sig = inspect.signature(fcompute)
+                self._defaults = {k: v.default
+                                  for k, v in sig.parameters.items()
+                                  if v.default is not inspect.Parameter.empty}
+            except (TypeError, ValueError):
+                self._defaults = {}
+        else:
+            self._defaults = {}
 
     def arg_names(self, params: dict):
         """Required input names given static params, or None if unnamed
@@ -107,15 +158,37 @@ class Operator:
         return names
 
     def _param_default(self, pname):
-        if not hasattr(self, "_defaults"):
-            import inspect
-            try:
-                sig = inspect.signature(self.fcompute)
-                self._defaults = {k: v.default for k, v in sig.parameters.items()
-                                  if v.default is not inspect.Parameter.empty}
-            except (TypeError, ValueError):
-                self._defaults = {}
         return self._defaults.get(pname)
+
+    def contract(self):
+        """Machine-readable registration contract for static analysis.
+
+        Everything the op promised at registration time, in plain data —
+        analysis/graftlint verifies these promises against the fcompute
+        signature and body without importing anything op-specific."""
+        fname, line = _source_of(self.fcompute)
+        return {
+            "name": self.name,
+            "num_inputs": self.num_inputs,
+            "num_outputs": self.num_outputs,
+            "num_visible_outputs": self.num_visible_outputs,
+            "differentiable": self.differentiable,
+            "needs_rng": self.needs_rng,
+            "takes_is_train": self.takes_is_train,
+            "nograd_inputs": list(self.nograd_inputs),
+            "mutate_inputs": list(self.mutate_inputs),
+            "input_names": (None if self.input_names is None
+                            else list(self.input_names)),
+            "aux_input_names": list(self.aux_input_names),
+            "has_fargnames": self.fargnames is not None,
+            "has_finfer_params": self.finfer_params is not None,
+            "has_fvisible": self.fvisible is not None,
+            "has_fnum_outputs": self.fnum_outputs is not None,
+            "no_jit": self.no_jit,
+            "param_defaults": dict(self._defaults),
+            "source_file": fname,
+            "source_line": line,
+        }
 
     def visible_outputs(self, params: dict, n_outputs: int) -> int:
         """How many of ``n_outputs`` are user-visible (rest are aux, e.g.
@@ -175,8 +248,10 @@ def register(name, **kwargs):
 
     def dec(fcompute):
         op = Operator(name, fcompute, doc=fcompute.__doc__ or "", **kwargs)
+        _log_registration(name, op)
         _REGISTRY[name] = op
         for a in aliases:
+            _log_registration(a, op, alias_of=name)
             _REGISTRY[a] = op
         return fcompute
 
@@ -186,6 +261,7 @@ def register(name, **kwargs):
 def alias(existing, *names):
     op = _REGISTRY[existing]
     for n in names:
+        _log_registration(n, op, alias_of=existing)
         _REGISTRY[n] = op
 
 

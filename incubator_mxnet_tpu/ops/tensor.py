@@ -192,7 +192,8 @@ def _reverse(data, axis=()):
 @register("Pad", num_inputs=1, aliases=("pad",))
 def _pad(data, mode="constant", pad_width=(), constant_value=0.0):
     """ref: src/operator/pad.cc (pad_width in mxnet flat before/after pairs)."""
-    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    pw = [(int(pad_width[2 * i]), int(pad_width[2 * i + 1]))
+          for i in range(len(pad_width) // 2)]
     mode_map = {"constant": "constant", "edge": "edge", "reflect": "reflect"}
     if mode == "constant":
         return jnp.pad(data, pw, mode="constant", constant_values=constant_value)
@@ -293,7 +294,8 @@ def _where(condition, x, y):
 # ---------------------------------------------------------------------------
 
 
-@register("topk", num_inputs=1, differentiable=False)
+@register("topk", num_inputs=1, differentiable=False,
+          fnum_outputs=lambda p: 2 if p.get("ret_typ") == "both" else 1)
 def _topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
     """ref: ordering_op.cc topk"""
     x = jnp.moveaxis(data, axis, -1)

@@ -289,6 +289,7 @@ def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
 
 
 @register("Proposal", num_inputs=3, differentiable=False,
+          fnum_outputs=lambda p: 2 if p.get("output_score") else 1,
           aliases=("_contrib_Proposal", "_contrib_MultiProposal",
                    "MultiProposal"))
 def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
@@ -466,18 +467,9 @@ def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
     return out[:, :, ::stride1, ::stride1]
 
 
-@register("Pad", num_inputs=1)
-def _pad(data, mode="constant", pad_width=(), constant_value=0.0):
-    """ref: src/operator/pad.cc — constant/edge/reflect padding."""
-    pw = [(int(pad_width[2 * i]), int(pad_width[2 * i + 1]))
-          for i in range(len(pad_width) // 2)]
-    if mode == "constant":
-        return jnp.pad(data, pw, constant_values=constant_value)
-    if mode == "edge":
-        return jnp.pad(data, pw, mode="edge")
-    if mode == "reflect":
-        return jnp.pad(data, pw, mode="reflect")
-    raise ValueError("unknown pad mode %r" % mode)
+# NOTE: "Pad" is registered once, in tensor.py (graftlint GL107 caught the
+# duplicate registration that used to live here: it silently shadowed the
+# canonical op for the "Pad" spelling while "pad" kept the original).
 
 
 @register("Crop", num_inputs=None)

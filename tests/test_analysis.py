@@ -1,0 +1,434 @@
+"""graftlint (analysis/) — op-contract linter + strict-mode engine verifier.
+
+Pass 1 fixtures: one deliberately-broken registration per diagnostic
+rule, asserting the specific code (docs/static_analysis.md).  Fixture
+Operators are constructed directly (no registry pollution); only the
+collision test touches the registry and cleans up after itself.
+
+Pass 2: GRAFT_ENGINE_CHECK strict mode must (a) catch forced
+stale-extract / double-rebind / integrity / fusion hazards through the
+PR-1 view path, and (b) stay silent on correct programs (the whole
+tier-1 suite runs under GRAFT_ENGINE_CHECK=1).
+"""
+import contextlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd, engine
+from incubator_mxnet_tpu.analysis import contracts
+from incubator_mxnet_tpu.analysis.engine_check import EngineHazardError
+from incubator_mxnet_tpu.ndarray.ndarray import invoke
+from incubator_mxnet_tpu.ops.registry import (Operator, get_op, register,
+                                              registration_log, _REGISTRY,
+                                              _REGISTRATION_LOG)
+
+
+def _codes(diags):
+    return {d.code for d in diags if not d.suppressed}
+
+
+def _drop_fixture_registrations(prefix="_glt_"):
+    for name in [n for n in _REGISTRY if n.startswith(prefix)]:
+        _REGISTRY.pop(name, None)
+    _REGISTRATION_LOG[:] = [e for e in _REGISTRATION_LOG
+                            if not e["name"].startswith(prefix)]
+
+
+@pytest.fixture
+def fixture_registry():
+    """Yields register(); removes every _glt_* registration afterwards so
+    later full-registry lint runs (and other tests) stay clean."""
+    try:
+        yield register
+    finally:
+        _drop_fixture_registrations()
+
+
+@contextlib.contextmanager
+def strict_engine():
+    engine.set_engine_check(True)
+    try:
+        yield
+    finally:
+        engine.set_engine_check(None)
+
+
+# ---------------------------------------------------------------------------
+# pass 1 — one broken fixture per rule
+# ---------------------------------------------------------------------------
+
+def test_gl101_fixed_arity_mismatch():
+    def fc(data):
+        return data
+    assert "GL101" in _codes(contracts.lint_operator(
+        Operator("_glt_bad_arity", fc, num_inputs=2)))
+
+
+def test_gl101_fake_variadic():
+    def fc(a, b):
+        return a + b
+    assert "GL101" in _codes(contracts.lint_operator(
+        Operator("_glt_fake_variadic", fc, num_inputs=None)))
+
+
+def test_gl101_clean_on_true_variadic():
+    def fc(*args, axis=0):
+        return args[0]
+    assert "GL101" not in _codes(contracts.lint_operator(
+        Operator("_glt_varargs", fc, num_inputs=None)))
+
+
+def test_gl102_nograd_out_of_range():
+    def fc(data, indices):
+        return data
+    assert "GL102" in _codes(contracts.lint_operator(
+        Operator("_glt_bad_nograd", fc, num_inputs=2, nograd_inputs=(2,))))
+
+
+def test_gl103_mutate_out_of_range():
+    def fc(weight, grad):
+        return weight
+    assert "GL103" in _codes(contracts.lint_operator(
+        Operator("_glt_bad_mutate", fc, num_inputs=2, mutate_inputs=(7,),
+                 differentiable=False)))
+
+
+def test_gl104_rng_missing():
+    def fc(shape=()):
+        return jnp.zeros(shape)
+    assert "GL104" in _codes(contracts.lint_operator(
+        Operator("_glt_no_rng", fc, num_inputs=0, needs_rng=True,
+                 differentiable=False)))
+
+
+def test_gl104_rng_undeclared():
+    def fc(data, rng=None):
+        return data
+    assert "GL104" in _codes(contracts.lint_operator(
+        Operator("_glt_undeclared_rng", fc, num_inputs=1)))
+
+
+def test_gl105_is_train_missing():
+    def fc(data):
+        return data
+    assert "GL105" in _codes(contracts.lint_operator(
+        Operator("_glt_no_train", fc, num_inputs=1, takes_is_train=True)))
+
+
+def test_gl106_input_names_wrong_length():
+    def fc(data, weight):
+        return data
+    assert "GL106" in _codes(contracts.lint_operator(
+        Operator("_glt_names_len", fc, num_inputs=2,
+                 input_names=("data", "weight", "bias"))))
+
+
+def test_gl106_input_names_order_mismatch():
+    def fc(a, b):
+        return a + b
+    assert "GL106" in _codes(contracts.lint_operator(
+        Operator("_glt_names_order", fc, num_inputs=2,
+                 input_names=("x", "y"))))
+
+
+def test_gl106_no_bias_path_unresolvable():
+    def fc(data, weight, bias=None):
+        return data
+    assert "GL106" in _codes(contracts.lint_operator(
+        Operator("_glt_no_bias", fc, num_inputs=None,
+                 input_names=("data", "weight", "bias"))))
+
+
+def test_gl107_registration_collision(fixture_registry):
+    @fixture_registry("_glt_dup", num_inputs=1)
+    def fc1(data):
+        return data
+
+    @fixture_registry("_glt_dup", num_inputs=1)
+    def fc2(data):
+        return data * 2
+
+    diags = contracts.lint_all(names={"_glt_dup"})
+    hits = [d for d in diags if d.code == "GL107"]
+    assert hits and not hits[0].suppressed
+    assert any(e["name"] == "_glt_dup" and e["collided_with"] is not None
+               for e in registration_log())
+
+
+def test_gl108_host_rng():
+    def fc(data):
+        return data * np.random.rand()
+    assert "GL108" in _codes(contracts.lint_operator(
+        Operator("_glt_impure_rng", fc, num_inputs=1)))
+
+
+def test_gl108_numpy_on_array_input():
+    def fc(data):
+        return jnp.asarray(np.asarray(data).sum())
+    assert "GL108" in _codes(contracts.lint_operator(
+        Operator("_glt_np_input", fc, num_inputs=1)))
+
+
+def test_gl108_static_shape_math_not_flagged():
+    def fc(data, kernel=()):
+        size = float(np.prod(kernel))
+        return data * size
+    assert "GL108" not in _codes(contracts.lint_operator(
+        Operator("_glt_shape_math", fc, num_inputs=1)))
+
+
+def test_gl109_divergent_returns():
+    def fc(data, both=False):
+        if both:
+            return data, data * 2
+        return data
+    assert "GL109" in _codes(contracts.lint_operator(
+        Operator("_glt_divergent", fc, num_inputs=1)))
+
+
+def test_gl109_silent_with_fnum_outputs():
+    def fc(data, both=False):
+        if both:
+            return data, data * 2
+        return data
+    assert "GL109" not in _codes(contracts.lint_operator(
+        Operator("_glt_divergent_ok", fc, num_inputs=1,
+                 fnum_outputs=lambda p: 2 if p.get("both") else 1)))
+
+
+def test_gl110_aux_not_subset():
+    def fc(data, gamma):
+        return data
+    assert "GL110" in _codes(contracts.lint_operator(
+        Operator("_glt_bad_aux", fc, num_inputs=2,
+                 input_names=("data", "gamma"),
+                 aux_input_names=("moving_mean",))))
+
+
+def test_suppression_comment_honored():
+    # graftlint: disable=GL101 -- fixture: wrong arity on purpose
+    def fc(data):
+        return data
+    diags = [d for d in contracts.lint_operator(
+        Operator("_glt_suppressed", fc, num_inputs=3)) if d.code == "GL101"]
+    assert diags, "GL101 should still be reported"
+    assert all(d.suppressed for d in diags)
+    assert "fixture" in diags[0].justification
+
+
+def test_repo_registry_lints_clean():
+    """The live registry must stay clean — every future op PR inherits
+    this check for free (fixture ops excluded defensively)."""
+    diags = [d for d in contracts.lint_all()
+             if not d.suppressed and not d.op_name.startswith("_glt_")]
+    assert not diags, "\n".join(repr(d) for d in diags)
+
+
+def test_graftlint_cli_json(capsys):
+    import json
+    from incubator_mxnet_tpu.analysis.graftlint import main
+    assert main(["--ops", "take,topk,Convolution", "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["version"] == 1 and report["total"] == 0
+    assert isinstance(report["counts"], dict)
+
+
+# ---------------------------------------------------------------------------
+# registry contract metadata
+# ---------------------------------------------------------------------------
+
+def test_operator_contract_metadata():
+    c = get_op("take").contract()
+    assert c["num_inputs"] == 2 and c["nograd_inputs"] == [1]
+    assert c["source_file"].endswith("tensor.py") and c["source_line"] > 0
+    assert c["param_defaults"]["mode"] == "clip"
+    c = get_op("BatchNorm").contract()
+    assert c["takes_is_train"] and c["aux_input_names"] == [
+        "moving_mean", "moving_var"]
+
+
+def test_operator_defaults_populated_eagerly():
+    """_defaults is built in __init__ — introspection (the linter, symbol
+    executors) must never mutate Operator instances mid-flight."""
+    def fc(data, no_bias=True, eps=1e-5):
+        return data
+    op = Operator("_glt_defaults", fc, num_inputs=1)
+    assert "_defaults" in op.__dict__
+    assert op._defaults == {"no_bias": True, "eps": 1e-5}
+    before = dict(op.__dict__)
+    assert op._param_default("no_bias") is True
+    assert op._param_default("missing") is None
+    assert dict(op.__dict__) == before
+
+
+# ---------------------------------------------------------------------------
+# view-group bookkeeping (ndarray)
+# ---------------------------------------------------------------------------
+
+def test_view_group_tracks_live_views():
+    a = nd.array(np.arange(12.0).reshape(3, 4))
+    v1 = a.reshape((4, 3))
+    v2 = a[1:3]
+    root, views = v1._view_group()
+    assert root is a
+    assert set(id(v) for v in views) >= {id(v1), id(v2)}
+    del views, v2
+    import gc
+    gc.collect()
+    assert id(v1) in {id(v) for v in a._live_views()}
+    assert len(a._live_views()) == 1
+
+
+# ---------------------------------------------------------------------------
+# pass 2 — strict-mode engine hazards (GRAFT_ENGINE_CHECK)
+# ---------------------------------------------------------------------------
+
+def test_engine_check_env_toggle(monkeypatch):
+    engine.set_engine_check(None)
+    monkeypatch.delenv("GRAFT_ENGINE_CHECK", raising=False)
+    assert not engine.engine_check_enabled()
+    monkeypatch.setenv("GRAFT_ENGINE_CHECK", "1")
+    assert engine.engine_check_enabled()
+    with engine.bulk(4):
+        assert engine._current().check
+    monkeypatch.delenv("GRAFT_ENGINE_CHECK")
+    assert not engine.engine_check_enabled()
+
+
+def test_strict_mode_stale_extract_hazard():
+    """EH101 — write-after-read through the PR-1 view path: an extract
+    pending recorded at base version V fed back after the base rebound.
+    (Production paths re-extract via the _cache_version guard; the
+    strict check proves the guard's invariant is actually enforceable.)"""
+    with strict_engine():
+        with engine.bulk(32):
+            r = nd.array(np.arange(12.0).reshape(3, 4))
+            r2 = r + 1
+            v = r2.reshape((4, 3))
+            p = v._read_deferred()        # records _bulk_view_extract
+            assert type(p) is engine._Pending
+            r2 += 1                       # rebinds the base: version moves
+            # feed the stale extract back THROUGH the view (production's
+            # _read_deferred re-extracts instead — this simulates that
+            # guard being bypassed, the invariant EH101 verifies)
+            with pytest.raises(EngineHazardError) as ei:
+                engine.maybe_defer(get_op("abs"), {}, [p], False, {},
+                                   nd_inputs=[v])
+            assert ei.value.code == "EH101"
+            assert ei.value.detail["current_version"] > \
+                ei.value.detail["recorded_version"]
+
+
+def test_strict_mode_snapshot_copy_is_not_a_hazard():
+    """A stale extract reached through a DIFFERENT owner is a legal
+    snapshot: `w[:] = v` copies the pre-write view value, and a later
+    base rebind must not trip EH101 (the recorded program replays the
+    same pre-write snapshot eager copy semantics produced)."""
+    with strict_engine():
+        with engine.bulk(32):
+            r2 = nd.array(np.arange(12.0).reshape(3, 4)) + 1
+            v = r2.reshape((12,))
+            w = nd.array(np.zeros(12, np.float32))
+            w[:] = v                      # snapshot of the pre-write view
+            r2 += 1                       # base rebinds afterwards
+            got = (w + 1).asnumpy()
+    np.testing.assert_allclose(
+        got, np.arange(12.0) + 2)         # (x+1) snapshot, +1
+
+
+def test_strict_mode_double_rebind_hazard():
+    """EH102 — lost update: a _bulk_view_write whose base operand is no
+    longer the base's current binding would discard the write between."""
+    with strict_engine():
+        with engine.bulk(32):
+            r = nd.array(np.arange(12.0).reshape(3, 4))
+            r2 = r * 1
+            v = r2.reshape((12,))
+            stale = r2._data              # binding BEFORE the first write
+            v[:] = 5.0                    # first rebind (recorded write)
+            with pytest.raises(EngineHazardError) as ei:
+                engine.maybe_defer(get_op("_bulk_view_write"),
+                                   {"offset": 0},
+                                   [stale, jnp.zeros((12,), jnp.float32)],
+                                   False, {}, nd_inputs=[r2, None])
+            assert ei.value.code == "EH102"
+
+
+def test_strict_mode_segment_integrity_hazard():
+    """EH103 — an ext operand no instruction references (orphans corrupt
+    the replay-cache key; see maybe_defer's staging invariant)."""
+    with strict_engine():
+        with engine.bulk(8):
+            a = nd.array(np.ones((2, 2), np.float32))
+            a + 1
+            engine._current().ext.append(jnp.zeros((2,)))
+            with pytest.raises(EngineHazardError) as ei:
+                engine.flush()
+            assert ei.value.code == "EH103"
+        # scope-close flush after the hazard must be a clean no-op
+    with strict_engine():
+        with engine.bulk(8):
+            b = nd.array(np.ones((2,), np.float32))
+            assert (b + 1).asnumpy() is not None
+
+
+def test_strict_mode_fusion_oracle_catches_divergence(fixture_registry):
+    """EH104 — an op whose traced and eager semantics differ is exactly
+    what the fused/unfused bit-comparison oracle must catch."""
+    @fixture_registry("_glt_jekyll", num_inputs=1, differentiable=False)
+    def _glt_jekyll(x):
+        if isinstance(x, jax.core.Tracer):
+            return x + 1.0
+        return x + 2.0
+
+    with strict_engine():
+        with pytest.raises(EngineHazardError) as ei:
+            with engine.bulk(8):
+                a = nd.array(np.ones((2, 2), np.float32))
+                invoke(get_op("_glt_jekyll"), [a], {}).asnumpy()
+        assert ei.value.code == "EH104"
+        assert "_glt_jekyll" in ei.value.detail["ops"]
+
+    # same program, checks FORCED off: the divergence goes unnoticed
+    # (this is precisely the blind spot strict mode exists to close)
+    engine.set_engine_check(False)
+    try:
+        with engine.bulk(8):
+            a = nd.array(np.ones((2, 2), np.float32))
+            out = invoke(get_op("_glt_jekyll"), [a], {}).asnumpy()
+    finally:
+        engine.set_engine_check(None)
+    np.testing.assert_allclose(out, 2.0)  # fused value ships silently
+
+
+def test_strict_mode_clean_on_correct_programs():
+    """No false positives: a realistic bulked program (views, in-place
+    writes, autograd) under strict mode matches eager exactly."""
+    rs = np.random.RandomState(7)
+    aw = rs.rand(6, 4).astype(np.float32)
+
+    def run(bulked):
+        a = nd.array(aw)
+        a.attach_grad()
+        scope = engine.bulk(64) if bulked else contextlib.nullcontext()
+        with scope:
+            with autograd.record():
+                h = (a * 2).reshape((4, 6))
+                y = (h[1:3] + 1).sum()
+            y.backward()
+            c = a * 3
+            c += 1
+            v = c.reshape((24,))
+            v += 1                     # write-through via a deferred view
+            return c.asnumpy(), a.grad.asnumpy()
+
+    with strict_engine():
+        got_c, got_g = run(True)
+    want_c, want_g = run(False)
+    np.testing.assert_allclose(got_c, want_c, rtol=1e-6)
+    np.testing.assert_allclose(got_g, want_g, rtol=1e-6)

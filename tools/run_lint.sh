@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# lint tier of the verify recipe: the op-contract static analyzer must be
+# clean (suppressed findings are allowed; unsuppressed ones fail the
+# build).  Thin wrapper over the canonical entry point — graftlint itself
+# pins jax to CPU and one pass produces both the human summary and the
+# machine-readable JSON report (for bench/verdict diagnostic tracking).
+#
+# Usage: tools/run_lint.sh [report.json]
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+REPORT="${1:-/tmp/graftlint_report.json}"
+exec python -m incubator_mxnet_tpu.analysis.graftlint --all --report "$REPORT"
