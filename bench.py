@@ -161,6 +161,7 @@ def main():
             "backend": backend,
             "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
             "host_pipeline_img_per_sec": round(pipe_img_s, 2),
+            "metrics": mx.telemetry.compact_snapshot(),
         }))
         return
     else:
@@ -187,6 +188,7 @@ def main():
         "unit": "img/s",
         "backend": backend,
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        "metrics": mx.telemetry.compact_snapshot(),
     }))
 
 

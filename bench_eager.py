@@ -195,6 +195,9 @@ def main():
         "train_flush_causes": train_stats["causes"],
         "train_segment_len_hist": {str(k): v for k, v in sorted(
             train_stats["segment_lengths"].items())},
+        # graftscope: the registry snapshot rides along so the perf
+        # trajectory carries flush/segment/phase counters per round
+        "metrics": mx.telemetry.compact_snapshot(),
     }))
 
 

@@ -60,6 +60,7 @@ from . import rnn
 from . import executor_manager
 from . import rtc
 from . import profiler
+from . import telemetry
 from . import config
 from . import visualization
 from . import visualization as viz

@@ -139,6 +139,8 @@ def _run_backward(heads, head_grads, retain_graph, train_mode, variables=None,
 
     s = _st()
     tape = list(s.tape)
+    from ..telemetry import metrics as _tmetrics
+    _tmetrics.autograd_backward(len(tape))
     grads: dict[int, object] = {}
 
     from ..ndarray.ndarray import NDArray, invoke
@@ -267,8 +269,10 @@ def _recorded_vjp(node, ct_nds):
 
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     """ref: autograd.py:243 / MXAutogradBackwardEx."""
-    with _scope(training=train_mode):
-        _run_backward(heads, head_grads, retain_graph, train_mode)
+    from ..telemetry import tracing as _ttracing
+    with _ttracing.phase_span("bwd"):
+        with _scope(training=train_mode):
+            _run_backward(heads, head_grads, retain_graph, train_mode)
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,

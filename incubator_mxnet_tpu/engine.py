@@ -87,6 +87,9 @@ import jax.numpy as jnp
 
 from .analysis.engine_check import (EngineHazardError,
                                     check_segment_integrity, oracle_compare)
+from . import profiler as _profiler
+from .telemetry import metrics as _tmetrics
+from .telemetry import tracing as _ttracing
 
 __all__ = ["bulk", "flush", "flush_stats", "reset_flush_stats",
            "EngineHazardError", "engine_check_enabled", "set_engine_check"]
@@ -165,6 +168,12 @@ class _BulkState(object):
         #                          the dedup table otherwise)
         self.pendings = []       # _Pending objects in slot order
         self.any_recorded = False
+        self.seg_id = None       # telemetry segment id, assigned at the
+        #                          first recorded instruction (flush spans
+        #                          + record-event flow links share it)
+        self.flow_marks = []     # instruction indices that emitted a flow
+        #                          start ("s") — flush finishes exactly
+        #                          these, never a dangling arrow
 
     def add_ext(self, v, owner=None):
         # dedup by (owner NDArray, buffer): two distinct NDArrays can
@@ -192,7 +201,8 @@ _infer_cache = {}   # (op, input sig, params, train) -> output sig; shape
 # inference via jax.eval_shape costs ~a dispatch itself, so recording
 # would be slower than executing without this memo
 
-_FLUSH_CAUSES = ("scope-close", "size-cap", "view", "read", "autograd")
+_FLUSH_CAUSES = ("scope-close", "size-cap", "view", "read", "autograd",
+                 "monitor")
 _flush_causes = {c: 0 for c in _FLUSH_CAUSES}
 _segment_hist = {}   # instructions-per-flush -> count
 
@@ -208,6 +218,8 @@ def reset_flush_stats():
     for c in _FLUSH_CAUSES:
         _flush_causes[c] = 0
     _segment_hist.clear()
+    _tmetrics.reset_engine_metrics()   # keep both views of one event
+    #                                    stream in agreement
 
 
 def _current():
@@ -253,6 +265,11 @@ def maybe_defer(op, params, vals, is_train, kw, rec=False, nd_inputs=None,
         # freshly created outputs get their owner refs only once invoke
         # wraps them — flushing in between would mis-classify them dead)
         flush(cause="size-cap")
+    # deferred records are traced as near-zero "record" events, never as
+    # op runtime: the cost lands on the owning segment's flush span, and
+    # a chrome-trace flow (s→f) draws the record→flush attribution arrow
+    trace = _ttracing.record_active()
+    t0 = _profiler._now_us() if trace else 0.0
     from .ops.registry import _hashable
     # stage input refs WITHOUT touching st yet: if we bail (stale
     # pending, failed inference) no orphan ext entries may pollute the
@@ -298,6 +315,13 @@ def maybe_defer(op, params, vals, is_train, kw, rec=False, nd_inputs=None,
                             bool(is_train), tuple(in_refs), rng_slot,
                             len(outs), bool(rec)))
     st.any_recorded |= bool(rec)
+    if st.seg_id is None:
+        st.seg_id = _ttracing.next_segment_id()
+    if trace:
+        idx = len(st.instructions) - 1
+        st.flow_marks.append(idx)
+        _ttracing.deferred_op_event(op.name, t0, _profiler._now_us(),
+                                    st.seg_id, idx)
     return tuple(outs)
 
 
@@ -562,11 +586,14 @@ def flush(state=None, cause="read"):
     _flush_causes[cause] = _flush_causes.get(cause, 0) + 1
     _segment_hist[len(st.instructions)] = \
         _segment_hist.get(len(st.instructions), 0) + 1
+    _tmetrics.engine_flush(cause, len(st.instructions))
     instrs = st.instructions
     ext = st.ext
     ext_owners = st.ext_owners
     pendings = st.pendings
     recorded = st.any_recorded
+    seg_id = st.seg_id
+    flow_marks = st.flow_marks
     # reset the scope so new ops start a fresh segment (and so re-entrant
     # flushes from _read during execution see an empty program)
     st.instructions, st.ext, st.pendings = [], [], []
@@ -575,6 +602,8 @@ def flush(state=None, cause="read"):
     st.ext_pins = []
     st.any_recorded = False
     st.extract_meta = {}
+    st.seg_id = None
+    st.flow_marks = []
     st.epoch += 1
 
     if st.check:
@@ -610,7 +639,10 @@ def flush(state=None, cause="read"):
                  in instrs),
            tuple((tuple(v.shape), str(v.dtype)) for v in ext),
            live)
+    prof_on = _profiler._P.active()
+    span_begin = _profiler._now_us() if prof_on else 0.0
     entry = _replay_cache.get(key)
+    cache_hit = entry is not None
     if entry is None:
         replay = _build_replay(instrs, live)
         entry = (jax.jit(replay), replay)
@@ -630,6 +662,21 @@ def flush(state=None, cause="read"):
         for p in pendings:
             p.error = exc
         raise
+    if prof_on or flow_marks:
+        # the segment span is where op cost actually lands: with
+        # profiler.sync the dispatch blocks until ready, so the span IS
+        # device latency (the flush-level analogue of sync-mode op spans).
+        # A segment whose records emitted flow starts ALWAYS closes its
+        # links here, even if the profiler was stopped mid-segment —
+        # a dangling arrow would fail the trace validator
+        device_time = _profiler.want_sync()
+        if device_time and results:
+            jax.block_until_ready(results)
+        begin = span_begin if prof_on else _profiler._now_us()
+        _ttracing.segment_flush_span(
+            seg_id, cause, begin, _profiler._now_us(),
+            flow_marks, len(instrs), len(live), cache_hit,
+            recorded, device_time)
     for i, v in zip(live, results):
         pendings[i].value = v
     if recorded:

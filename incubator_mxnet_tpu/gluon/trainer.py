@@ -123,8 +123,11 @@ class Trainer(object):
         self._optimizer.rescale_grad = self._scale / batch_size
         if not self._kv_initialized:
             self._init_kvstore()
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        from ..telemetry import tracing as _ttracing
+        with _ttracing.phase_span("kvstore"):
+            self._allreduce_grads()
+        with _ttracing.phase_span("update"):
+            self._update(ignore_stale_grad)
 
     def allreduce_grads(self):
         """ref: trainer.py allreduce_grads (1.3+, for grad accumulation)."""

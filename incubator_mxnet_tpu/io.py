@@ -95,7 +95,12 @@ class DataIter(object):
         raise StopIteration
 
     def __next__(self):
-        return self.next()
+        batch = self.next()
+        # pipeline throughput telemetry: batches_total counter +
+        # batches/sec EWMA gauge per iterator class (graftscope)
+        from .telemetry import metrics as _tmetrics
+        _tmetrics.io_batch(type(self).__name__)
+        return batch
 
     def iter_next(self):
         pass

@@ -29,6 +29,25 @@ from .base import MXNetError
 from .ndarray import NDArray
 from .ndarray import ndarray as _nd
 from . import optimizer as opt
+from .telemetry import metrics as _tmetrics
+
+
+def _nd_bytes(arr):
+    """Logical payload size from metadata only (never forces a flush)."""
+    n = 1
+    for s in arr.shape:
+        n *= int(s)
+    return n * np.dtype(arr.dtype).itemsize
+
+
+def _wire_bytes(nbytes, compressor):
+    """Post-compression size of an ``nbytes`` payload on the wire: 2-bit
+    quantization packs 16 elements per float32 word (ref:
+    gradient_compression.h packing) — the single place this ratio lives,
+    shared with the dist paths."""
+    if compressor is None:
+        return nbytes
+    return max(nbytes // 16, 1)
 
 __all__ = ["KVStore", "create", "create_kvstore"]
 
@@ -100,13 +119,18 @@ class KVStore(object):
         """
         keys, values = self._normalize(key, value)
         entries = []            # ordered (key, reduced) — keys may repeat
+        raw_bytes = wire_bytes = 0
         for k, vlist in zip(keys, values):
             if k not in self._store:
                 raise MXNetError("key %s has not been initialized" % k)
             red = self._reduce(vlist)
+            nb = _nd_bytes(red)
+            raw_bytes += nb
+            wire_bytes += _wire_bytes(nb, self._compressor)
             if self._compressor is not None:
                 red = self._compressor.compress(k, red)
             entries.append((k, red))
+        _tmetrics.kvstore_push(raw_bytes, wire_bytes)
         # one fused cross-worker collective for the whole push
         # (ref: big-array sharding amortization, kvstore_dist.h — here the
         # amortization is batching keys into a single allreduce)
@@ -128,12 +152,15 @@ class KVStore(object):
         """Broadcast store value into out list (ref: KVStore::Pull)."""
         assert out is not None
         keys, outs = self._normalize(key, out)
+        pulled = 0
         for k, olist in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("key %s has not been initialized" % k)
             src = self._store[k]
             for o in olist:
                 o._write(src._read().astype(o.dtype))
+                pulled += _nd_bytes(o)
+        _tmetrics.kvstore_pull(pulled)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only selected rows (ref: KVStore::PullRowSparse,

@@ -87,9 +87,13 @@ class BaseModule(object):
         return self._symbol
 
     def forward_backward(self, data_batch):
-        """ref: base_module.py forward_backward."""
-        self.forward(data_batch, is_train=True)
-        self.backward()
+        """ref: base_module.py forward_backward — per-batch fwd/bwd phase
+        spans (graftscope training-loop hooks)."""
+        from ..telemetry import tracing as _ttracing
+        with _ttracing.phase_span("fwd"):
+            self.forward(data_batch, is_train=True)
+        with _ttracing.phase_span("bwd"):
+            self.backward()
 
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,

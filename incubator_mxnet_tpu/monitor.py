@@ -36,11 +36,32 @@ class Monitor(object):
         self.re_prog = re.compile(pattern)
         self.sort = sort
 
+    @staticmethod
+    def _is_deferred(array):
+        from .engine import _Pending
+        d = getattr(array, "_data", None)
+        return type(d) is _Pending and d.value is None
+
     def stat_helper(self, name, array):
-        """Callback attached to executors (ref: monitor.py stat_helper)."""
+        """Callback attached to executors (ref: monitor.py stat_helper).
+
+        Concrete arrays are reduced to their stat immediately (no tensor
+        is pinned).  DEFERRED arrays (a bulk segment in flight) queue the
+        reference instead, and ``toc()`` computes the stat behind one
+        engine flush — computing here would force an ``asnumpy()``
+        materialization per intermediate output, fragmenting every bulk
+        segment the monitored step built (and miscounting the flushes as
+        user ``read``s)."""
         if not self.activated or not self.re_prog.match(name):
             return
-        self.queue.append((self.step, name, self.stat_func(array)))
+        self._enqueue(name, array)
+
+    def _enqueue(self, name, array):
+        if self._is_deferred(array):
+            self.queue.append((self.step, name, array, True))
+        else:
+            self.queue.append((self.step, name, self.stat_func(array),
+                               False))
 
     def install(self, exe):
         """ref: monitor.py install → set_monitor_callback."""
@@ -65,7 +86,11 @@ class Monitor(object):
     def toc(self):
         """Close the window: append matching *parameter* stats to the
         layer-output stats gathered by the executor tap, and return
-        [(step, name, formatted stat)] (ref: monitor.py toc contract)."""
+        [(step, name, formatted stat)] (ref: monitor.py toc contract).
+
+        All queued arrays materialize behind ONE engine flush tagged
+        ``cause="monitor"`` — ``flush_stats()`` attributes monitoring
+        cost to the monitor, not to user reads."""
         if not self.activated:
             return []
         self.activated = False
@@ -73,10 +98,15 @@ class Monitor(object):
             exe.outputs and exe.outputs[0].wait_to_read()
             for name, array in zip(exe._arg_names, exe.arg_arrays):
                 if self.re_prog.match(name):
-                    self.queue.append((self.step, name,
-                                       self.stat_func(array)))
-        entries = sorted(self.queue, key=lambda e: e[1]) if self.sort \
-            else self.queue
+                    self._enqueue(name, array)
+        if any(lazy for _, _, _, lazy in self.queue):
+            from . import engine
+            engine.flush(cause="monitor")
+        entries = [(step, name,
+                    self.stat_func(payload) if lazy else payload)
+                   for step, name, payload, lazy in self.queue]
+        if self.sort:
+            entries = sorted(entries, key=lambda e: e[1])
         self.queue = []
         return [(step, name, self._fmt(stat))
                 for step, name, stat in entries]

@@ -724,7 +724,11 @@ def invoke(op: Operator, inputs, params, out=None):
     vals = [a._read() for a in inputs]
 
     from .. import profiler as _profiler
-    _span = _profiler.op_span(op.name, "imperative")
+    # async dispatch: the span is dispatch time unless sync mode blocks
+    # until ready inside it — the event says which (graftscope satellite:
+    # op durations must never masquerade as device latency)
+    _span = _profiler.op_span(op.name, "imperative",
+                              args={"device_time": _profiler.want_sync()})
     if _span is not None:
         _span.__enter__()
     if recording:

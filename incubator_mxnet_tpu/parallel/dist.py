@@ -175,8 +175,11 @@ class DistKVStore(KVStore):
         if self._ps is None:
             return super().push(key, value, priority)
         from ..ndarray.sparse import BaseSparseNDArray
+        from ..kvstore import _nd_bytes, _wire_bytes
+        from ..telemetry import metrics as _tmetrics
         keys, values = self._normalize(key, value)
         batch = {}
+        raw_bytes = wire_bytes = 0
         for k, vlist in zip(keys, values):
             if k not in self._store:
                 from ..base import MXNetError
@@ -184,9 +187,13 @@ class DistKVStore(KVStore):
             red = self._reduce(vlist)
             if isinstance(red, BaseSparseNDArray):
                 red = red.tostype("default")
+            nb = _nd_bytes(red)
+            raw_bytes += nb
+            wire_bytes += _wire_bytes(nb, self._compressor)
             if self._compressor is not None:
                 red = self._compressor.compress(k, red)
             batch[str(k)] = self._async_np(red)
+        _tmetrics.kvstore_push(raw_bytes, wire_bytes)
         self._ps.push(batch)    # applied immediately server-side; returns
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
@@ -194,16 +201,21 @@ class DistKVStore(KVStore):
             return super().pull(key, out=out, priority=priority,
                                 ignore_sparse=ignore_sparse)
         import jax.numpy as _jnp
+        from ..kvstore import _nd_bytes
+        from ..telemetry import metrics as _tmetrics
         assert out is not None
         keys, outs = self._normalize(key, out)
         fetched = self._ps.pull([str(k) for k in keys])
+        pulled = 0
         for k, olist in zip(keys, outs):
             v = fetched[str(k)]
             for o in olist:
                 o._write(_jnp.asarray(v).astype(o.dtype))
+                pulled += _nd_bytes(o)
             # refresh the local mirror so row_sparse_pull etc. see it
             self._store[k]._write(_jnp.asarray(v).astype(
                 self._store[k].dtype))
+        _tmetrics.kvstore_pull(pulled)
 
     def set_optimizer(self, optimizer):
         if self._ps is None:

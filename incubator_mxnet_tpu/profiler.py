@@ -123,25 +123,42 @@ def record_event(name, begin_us, end_us, cat="operator", tid=0, args=None):
         _P.events.append(ev)
 
 
+def append_raw_event(ev):
+    """Append a pre-built chrome-trace event dict (flow events etc. from
+    telemetry.tracing — the profiler stays the single event sink)."""
+    with _lock:
+        _P.events.append(ev)
+
+
+def profile_imperative_enabled():
+    return _P.profile_imperative
+
+
 class _OpSpan:
     """Context manager timing one op dispatch (ProfileOperator reborn,
-    threaded_engine.h:339-350)."""
+    threaded_engine.h:339-350).
 
-    __slots__ = ("name", "begin")
+    Under async dispatch the measured span is DISPATCH time, not device
+    time — the event says so (``args.device_time``) so traces of a real
+    model body cannot be misread; ``sync=True`` config blocks until
+    ready inside the span and flips the flag (see invoke/Executor)."""
 
-    def __init__(self, name):
+    __slots__ = ("name", "begin", "args")
+
+    def __init__(self, name, args=None):
         self.name = name
+        self.args = args
 
     def __enter__(self):
         self.begin = _now_us()
         return self
 
     def __exit__(self, *exc):
-        record_event(self.name, self.begin, _now_us())
+        record_event(self.name, self.begin, _now_us(), args=self.args)
         return False
 
 
-def op_span(name, kind="imperative"):
+def op_span(name, kind="imperative", args=None):
     """Hook used by ndarray.invoke / Executor.forward; returns a context
     manager (or None when profiling is off, keeping the hot path free)."""
     if not _P.active():
@@ -150,7 +167,7 @@ def op_span(name, kind="imperative"):
         return None
     if kind == "symbolic" and not _P.profile_symbolic:
         return None
-    return _OpSpan(name)
+    return _OpSpan(name, args)
 
 
 def want_sync():

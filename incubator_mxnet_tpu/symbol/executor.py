@@ -126,8 +126,11 @@ class Executor(object):
         aux_vals = {n: self.aux_dict[n]._read() for n in self._aux_names}
         rng = random_state.next_key()
         from .. import profiler as _profiler
+        # same treatment as deferred op records: without sync the span is
+        # dispatch time of one jitted program, and the event says so
         _span = _profiler.op_span("Executor.forward(%s)"
-                                  % (self._symbol.name or "sym"), "symbolic")
+                                  % (self._symbol.name or "sym"), "symbolic",
+                                  args={"device_time": _profiler.want_sync()})
         if _span is not None:
             with _span:
                 out_vals, aux_out = entry["jit"](arg_vals, aux_vals, rng)

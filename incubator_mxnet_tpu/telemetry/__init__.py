@@ -1,0 +1,44 @@
+"""graftscope — segment-aware tracing + unified metrics for the deferred
+engine.
+
+Two halves (see docs/observability.md for the full guide):
+
+* :mod:`~incubator_mxnet_tpu.telemetry.tracing` — chrome-trace spans per
+  bulk-segment flush with flow links from each deferred op's record
+  event, so a trace of a bulked model body shows *where* cost actually
+  lands (the profiler still owns the event buffer and ``dump()``).
+* :mod:`~incubator_mxnet_tpu.telemetry.metrics` — the process-wide
+  Counter/Gauge/Histogram registry (engine flush causes, kvstore bytes
+  and compression ratio, io batches/sec, autograd tape sizes, device
+  memory, training phase latencies) with JSON snapshot and Prometheus
+  text expositions.
+
+CLI::
+
+    python -m incubator_mxnet_tpu.telemetry --summary [--json]
+
+Environment: ``GRAFT_TELEMETRY=0`` disables metric collection;
+``GRAFT_TELEMETRY_SNAPSHOT=<path>`` writes the JSON snapshot at process
+exit; ``GRAFT_TELEMETRY_TOPK`` sets the CLI's segment table size.
+"""
+from __future__ import annotations
+
+import os as _os
+
+from . import metrics
+from . import tracing
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      compact_snapshot, enabled, parse_prometheus_text,
+                      registry, set_enabled, write_snapshot)
+from .tracing import phase_span
+
+__all__ = ["metrics", "tracing", "Counter", "Gauge", "Histogram",
+           "MetricsRegistry", "registry", "enabled", "set_enabled",
+           "parse_prometheus_text", "compact_snapshot", "write_snapshot",
+           "phase_span"]
+
+_snapshot_path = _os.environ.get("GRAFT_TELEMETRY_SNAPSHOT")
+if _snapshot_path:
+    import atexit as _atexit
+
+    _atexit.register(lambda: write_snapshot(_snapshot_path))

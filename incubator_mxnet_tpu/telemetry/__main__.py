@@ -1,0 +1,214 @@
+"""graftscope CLI.
+
+    python -m incubator_mxnet_tpu.telemetry --summary [--json]
+        Run one bulked training step (gluon Trainer on CPU, a kvstore
+        attached) with segment tracing on, then render the top-k segment
+        flushes by device time and the metrics snapshot (flush causes,
+        kvstore bytes, device-memory gauges) FROM THAT RUN.
+
+    python -m incubator_mxnet_tpu.telemetry --summary --trace T.json
+        Same report over an existing chrome-trace dump (segment table
+        from the file; the metrics section reflects this process).
+
+    python -m incubator_mxnet_tpu.telemetry --selftest
+        Lint smoke tier: bulk a 3-op program, dump a trace, validate the
+        chrome-trace schema + non-empty flow links.  Exit 1 on any
+        regression.
+
+``GRAFT_TELEMETRY_TOPK`` (default 10) sizes the segment table.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+# pin jax to CPU before anything initializes a backend: the CLI must
+# work (and stay fast) on machines whose TPU is busy or absent
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _demo_training_step():
+    """One bulked gluon training step with every telemetry surface lit:
+    engine segments, autograd, kvstore push/pull, io batches."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, engine, gluon, io, profiler
+
+    net = gluon.nn.Dense(8)
+    net.initialize()
+    kvs = mx.kv.create("local")
+    x = mx.nd.array(np.random.RandomState(0).rand(4, 16).astype(np.float32))
+    y = mx.nd.array(np.zeros((4, 8), np.float32))
+    it = io.NDArrayIter(data=x.asnumpy(), label=y.asnumpy(), batch_size=4)
+    net(x).asnumpy()                       # param init outside the trace
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=kvs)
+
+    fd, path = tempfile.mkstemp(suffix=".json", prefix="graftscope_")
+    os.close(fd)
+    profiler.set_config(filename=path, profile_all=True)
+    profiler.set_state("run")
+    for batch in it:
+        data = batch.data[0]
+        with engine.bulk(64):
+            with autograd.record():
+                out = net(data)
+                loss = (out * out).mean()
+            loss.backward()
+        trainer.step(batch_size=data.shape[0])
+        loss.asnumpy()
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(path) as f:
+        trace = json.load(f)
+    os.unlink(path)
+    return trace
+
+
+def _summary(trace_events, top):
+    from incubator_mxnet_tpu import telemetry
+    from incubator_mxnet_tpu.telemetry import tracing
+    report = tracing.segment_summary(trace_events, top=top)
+    snap = telemetry.registry().snapshot()
+    report["metrics"] = snap
+    report["flush_causes"] = {
+        s["labels"]["cause"]: s["value"]
+        for s in snap.get("graft_engine_flushes_total",
+                          {"samples": []})["samples"]}
+    report["kvstore_bytes"] = {
+        k.replace("graft_kvstore_", "").replace("_total", ""): v
+        for k, v in telemetry.compact_snapshot().items()
+        if k.startswith("graft_kvstore_")}
+    report["device_memory"] = [
+        dict(s["labels"], bytes=s["value"])
+        for s in snap.get("graft_device_memory_bytes",
+                          {"samples": []})["samples"]]
+    return report
+
+
+def _render_text(report):
+    lines = ["graftscope summary", "=" * 60]
+    lines.append("top segments by flush time (%d total):"
+                 % report["segments_total"])
+    lines.append("%-8s %-12s %6s %12s %6s %s"
+                 % ("segment", "cause", "nodes", "dur(us)", "cache",
+                    "device_time"))
+    for s in report["top_segments"]:
+        lines.append("%-8s %-12s %6s %12.1f %6s %s"
+                     % (s["segment"], s["cause"], s["nodes"],
+                        s["duration_us"], s["cache"], s["device_time"]))
+    lines.append("")
+    lines.append("flush time by cause (us): %s"
+                 % json.dumps(report["flush_causes_us"]))
+    lines.append("flush counts by cause:    %s"
+                 % json.dumps(report["flush_causes"]))
+    lines.append("kvstore bytes:            %s"
+                 % json.dumps(report["kvstore_bytes"]))
+    lines.append("")
+    lines.append("device memory:")
+    for m in report["device_memory"]:
+        lines.append("  %-24s %-8s %16d" % (m["device"], m["kind"],
+                                            int(m["bytes"])))
+    lines.append("")
+    lines.append("full metrics snapshot: %d metric families"
+                 % len(report["metrics"]))
+    for k, v in sorted(report["metrics"].items()):
+        lines.append("  %-40s %s (%d series)"
+                     % (k, v["kind"], len(v["samples"])))
+    return "\n".join(lines)
+
+
+def selftest():
+    """Trace a 3-op bulked program and validate the dump (lint tier).
+    Returns a list of problems — empty means pass."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import engine, profiler
+    from incubator_mxnet_tpu.telemetry import tracing
+
+    fd, path = tempfile.mkstemp(suffix=".json", prefix="graftscope_self_")
+    os.close(fd)
+    profiler.set_config(filename=path, profile_all=True)
+    profiler.set_state("run")
+    a = mx.nd.array(np.ones((8, 8), np.float32))
+    with engine.bulk(16):
+        b = a * a
+        c = b + a
+        d = c - a
+        d.asnumpy()
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(path) as f:
+        trace = json.load(f)
+    os.unlink(path)
+    problems = tracing.validate_chrome_trace(trace)
+    events = trace["traceEvents"]
+    flows = [e for e in events if e.get("ph") in ("s", "f")]
+    if not flows:
+        problems.append("no flow events in the trace (record→flush links "
+                        "are gone)")
+    deferred = [e for e in events
+                if e.get("args", {}).get("deferred") is True]
+    if len(deferred) < 3:
+        problems.append("expected >=3 deferred op records, got %d"
+                        % len(deferred))
+    segs = [e for e in events if e.get("name") == tracing.SEGMENT_SPAN]
+    if not segs:
+        problems.append("no bulk_segment_flush span")
+    elif segs[0].get("args", {}).get("nodes") != 3:
+        problems.append("segment span nodes=%r, expected 3"
+                        % segs[0].get("args", {}).get("nodes"))
+    return problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m incubator_mxnet_tpu.telemetry",
+        description="graftscope: segment-aware tracing + metrics summary")
+    ap.add_argument("--summary", action="store_true",
+                    help="run (or load) a traced workload and report")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    ap.add_argument("--trace", metavar="PATH",
+                    help="summarize an existing chrome-trace dump instead "
+                         "of running the demo step")
+    ap.add_argument("--top", type=int,
+                    default=int(os.environ.get("GRAFT_TELEMETRY_TOPK",
+                                               "10")),
+                    help="segment table size (GRAFT_TELEMETRY_TOPK)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="trace a 3-op bulked program and validate the "
+                         "dump (CI smoke tier)")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        problems = selftest()
+        if problems:
+            for p in problems:
+                print("graftscope selftest FAIL: %s" % p, file=sys.stderr)
+            return 1
+        print("graftscope selftest OK (schema + flow links valid)")
+        return 0
+
+    if not args.summary:
+        ap.print_help()
+        return 2
+
+    if args.trace:
+        with open(args.trace) as f:
+            events = json.load(f)["traceEvents"]
+    else:
+        events = _demo_training_step()["traceEvents"]
+    report = _summary(events, args.top)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True, default=str))
+    else:
+        print(_render_text(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,543 @@
+"""Unified metrics registry: Counter / Gauge / Histogram with labels.
+
+The process-wide telemetry spine of the framework (graftscope).  Every
+subsystem that previously kept its own ad-hoc counters reports here:
+
+* engine        — flush causes + segment-length histogram (the registry
+                  absorbs ``engine.flush_stats()``: the counters ARE the
+                  backing data the dict view is rebuilt from),
+* kvstore       — push/pull raw bytes, wire bytes after gradient
+                  compression, cumulative compression ratio,
+* io            — batches delivered per iterator + batches/sec EWMA,
+* autograd      — tape size at backward time (histogram) and the live
+                  tape-node gauge,
+* device memory — per-device in-use/peak/limit gauges (sampled from
+                  ``profiler.device_memory()`` at snapshot time),
+* training loop — per-phase (fwd/bwd/update/kvstore) latency histograms.
+
+Two expositions: :meth:`MetricsRegistry.snapshot` (JSON-able dict, what
+the benches embed) and :meth:`MetricsRegistry.prometheus_text` (the
+Prometheus text format, round-trippable via
+:func:`parse_prometheus_text`).  ``GRAFT_TELEMETRY=0`` turns every
+increment into a no-op; the CLI (`python -m incubator_mxnet_tpu.telemetry`)
+renders the snapshot of the default registry.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
+           "enabled", "set_enabled", "parse_prometheus_text",
+           "compact_snapshot"]
+
+_enabled_override = None
+
+
+def set_enabled(flag):
+    """Force telemetry on/off (None = defer to GRAFT_TELEMETRY)."""
+    global _enabled_override
+    _enabled_override = flag
+
+
+def enabled():
+    if _enabled_override is not None:
+        return bool(_enabled_override)
+    return os.environ.get("GRAFT_TELEMETRY", "1").strip().lower() \
+        not in ("0", "false", "no", "off")
+
+
+def _label_key(labelnames, labels):
+    if set(labels) != set(labelnames):
+        raise ValueError("expected labels %s, got %s"
+                         % (list(labelnames), sorted(labels)))
+    return tuple(str(labels[n]) for n in labelnames)
+
+
+class _Metric(object):
+    kind = "untyped"
+
+    def __init__(self, name, help="", labelnames=()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._series = {}          # label-value tuple -> sample
+        self._lock = threading.Lock()
+
+    def _sample(self, labels):
+        key = _label_key(self.labelnames, labels)
+        s = self._series.get(key)
+        if s is None:
+            with self._lock:
+                s = self._series.setdefault(key, self._new_sample())
+        return s
+
+    def clear(self):
+        with self._lock:
+            self._series.clear()
+
+    def labels_of(self, key):
+        return dict(zip(self.labelnames, key))
+
+    def samples(self):
+        """[(labels dict, sample payload)] — payload shape is per-kind."""
+        with self._lock:
+            items = list(self._series.items())
+        return [(self.labels_of(k), self._export(s)) for k, s in items]
+
+
+class Counter(_Metric):
+    """Monotonic counter (per label set)."""
+
+    kind = "counter"
+
+    def _new_sample(self):
+        return [0.0]
+
+    def inc(self, value=1, **labels):
+        if not enabled():
+            return
+        if value < 0:
+            raise ValueError("counters only go up (got %r)" % value)
+        s = self._sample(labels)
+        with self._lock:
+            s[0] += value
+
+    def set(self, value, **labels):
+        """Collector-side absolute set (for mirroring external counters)."""
+        if not enabled():
+            return
+        s = self._sample(labels)
+        with self._lock:
+            s[0] = float(value)
+
+    def value(self, **labels):
+        return self._sample(labels)[0]
+
+    def _export(self, s):
+        return s[0]
+
+
+class Gauge(_Metric):
+    """Point-in-time value (per label set)."""
+
+    kind = "gauge"
+
+    def _new_sample(self):
+        return [0.0]
+
+    def set(self, value, **labels):
+        if not enabled():
+            return
+        s = self._sample(labels)
+        with self._lock:
+            s[0] = float(value)
+
+    def inc(self, value=1, **labels):
+        if not enabled():
+            return
+        s = self._sample(labels)
+        with self._lock:
+            s[0] += value
+
+    def dec(self, value=1, **labels):
+        self.inc(-value, **labels)
+
+    def value(self, **labels):
+        return self._sample(labels)[0]
+
+    def _export(self, s):
+        return s[0]
+
+
+_DEFAULT_BUCKETS = (0.0001, 0.001, 0.01, 0.1, 1.0, 10.0)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (Prometheus semantics: each bucket
+    counts observations ≤ its upper bound; +Inf is implicit)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(), buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+
+    def _new_sample(self):
+        # [counts per bucket..., +Inf count, sum]
+        return [0] * (len(self.buckets) + 1) + [0.0]
+
+    def observe(self, value, **labels):
+        if not enabled():
+            return
+        s = self._sample(labels)
+        with self._lock:
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    s[i] += 1
+            s[len(self.buckets)] += 1      # +Inf
+            s[-1] += value
+
+    def _export(self, s):
+        return {"buckets": {("%g" % b): s[i]
+                            for i, b in enumerate(self.buckets)},
+                "count": s[len(self.buckets)],
+                "sum": s[-1]}
+
+
+class MetricsRegistry(object):
+    """Named metric store + pull-collectors + expositions."""
+
+    def __init__(self):
+        self._metrics = {}
+        self._collectors = []
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls, name, help, labelnames, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, labelnames, **kw)
+                self._metrics[name] = m
+                return m
+        if type(m) is not cls or m.labelnames != tuple(labelnames):
+            raise ValueError("metric %r re-registered with a different "
+                             "kind/labels" % name)
+        return m
+
+    def counter(self, name, help="", labelnames=()):
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()):
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name, help="", labelnames=(),
+                  buckets=_DEFAULT_BUCKETS):
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def register_collector(self, fn):
+        """``fn(registry)`` runs before every snapshot/exposition — the
+        pull path for gauges sampled from live state (device memory,
+        autograd tape size)."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def _collect(self):
+        for fn in list(self._collectors):
+            try:
+                fn(self)
+            except Exception:
+                pass        # a broken collector must not kill exposition
+
+    def metrics(self):
+        with self._lock:
+            return list(self._metrics.values())
+
+    def reset(self, prefix=None):
+        """Zero every series (or only metrics whose name starts with
+        ``prefix``) — keeps registrations and collectors."""
+        for m in self.metrics():
+            if prefix is None or m.name.startswith(prefix):
+                m.clear()
+
+    def snapshot(self, collect=True):
+        """JSON-able dict of everything the registry holds."""
+        if collect:
+            self._collect()
+        out = {}
+        for m in self.metrics():
+            out[m.name] = {
+                "kind": m.kind,
+                "help": m.help,
+                "samples": [{"labels": labels, "value": payload}
+                            for labels, payload in m.samples()],
+            }
+        return out
+
+    def prometheus_text(self, collect=True):
+        """Prometheus text exposition format v0.0.4."""
+        if collect:
+            self._collect()
+        lines = []
+        for m in sorted(self.metrics(), key=lambda m: m.name):
+            lines.append("# HELP %s %s" % (m.name, m.help or m.name))
+            lines.append("# TYPE %s %s" % (m.name, m.kind))
+            for labels, payload in m.samples():
+                if m.kind == "histogram":
+                    for le, cnt in payload["buckets"].items():
+                        lines.append("%s_bucket%s %s" % (
+                            m.name, _fmt_labels(labels, le=le), _fmt(cnt)))
+                    lines.append("%s_bucket%s %s" % (
+                        m.name, _fmt_labels(labels, le="+Inf"),
+                        _fmt(payload["count"])))
+                    lines.append("%s_sum%s %s" % (
+                        m.name, _fmt_labels(labels), _fmt(payload["sum"])))
+                    lines.append("%s_count%s %s" % (
+                        m.name, _fmt_labels(labels), _fmt(payload["count"])))
+                else:
+                    lines.append("%s%s %s" % (m.name, _fmt_labels(labels),
+                                              _fmt(payload)))
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(v):
+    f = float(v)
+    return ("%d" % int(f)) if f == int(f) else repr(f)
+
+
+def _fmt_labels(labels, **extra):
+    items = list(labels.items()) + list(extra.items())
+    if not items:
+        return ""
+    body = ",".join('%s="%s"' % (k, str(v).replace("\\", "\\\\")
+                                 .replace('"', '\\"').replace("\n", "\\n"))
+                    for k, v in items)
+    return "{%s}" % body
+
+
+def parse_prometheus_text(text):
+    """Parse the text exposition back into
+    ``{metric_name: {frozenset(label items): value}}`` — the inverse used
+    by the round-trip tests (histogram series appear under their
+    ``_bucket``/``_sum``/``_count`` sample names, as on the wire)."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            labelstr, value = rest.rsplit("}", 1)
+            labels = {}
+            for part in _split_labels(labelstr):
+                k, v = part.split("=", 1)
+                v = v.strip()
+                if len(v) >= 2 and v[0] == '"' and v[-1] == '"':
+                    v = v[1:-1]
+                labels[k] = _unescape(v)
+        else:
+            name, value = line.rsplit(" ", 1)
+            labels = {}
+        out.setdefault(name.strip(), {})[
+            frozenset(labels.items())] = float(value)
+    return out
+
+
+_UNESCAPES = {"n": "\n", "\\": "\\", '"': '"'}
+
+
+def _unescape(v):
+    """Left-to-right escape decoding — sequential str.replace passes
+    corrupt values like a literal backslash followed by 'n'."""
+    out = []
+    i = 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append(_UNESCAPES.get(nxt, "\\" + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _split_labels(s):
+    parts, buf, in_q, esc = [], [], False, False
+    for ch in s:
+        if esc:
+            buf.append(ch)
+            esc = False
+        elif ch == "\\":
+            buf.append(ch)
+            esc = True
+        elif ch == '"':
+            buf.append(ch)
+            in_q = not in_q
+        elif ch == "," and not in_q:
+            parts.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+    if buf:
+        parts.append("".join(buf))
+    return [p for p in parts if p.strip()]
+
+
+# ---------------------------------------------------------------------------
+# default registry + the graft_* metric catalog (see docs/observability.md)
+# ---------------------------------------------------------------------------
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry():
+    """The process-wide default registry."""
+    return _REGISTRY
+
+
+_SEGMENT_LEN_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+_PHASE_BUCKETS = (1e-4, 1e-3, 1e-2, 0.1, 0.5, 1.0, 5.0, 30.0)
+
+
+def engine_flush(cause, n_instructions):
+    """Engine flush accounting (called once per executed flush)."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    r.counter("graft_engine_flushes_total",
+              "Bulk-segment flushes by cause",
+              ("cause",)).inc(cause=cause)
+    r.histogram("graft_engine_segment_length",
+                "Instructions per flushed bulk segment", (),
+                buckets=_SEGMENT_LEN_BUCKETS).observe(n_instructions)
+    r.counter("graft_engine_deferred_ops_total",
+              "Ops recorded into bulk segments").inc(n_instructions)
+
+
+def reset_engine_metrics():
+    """Paired with ``engine.reset_flush_stats()`` so both views agree."""
+    _REGISTRY.reset(prefix="graft_engine_")
+
+
+def kvstore_push(raw_bytes, wire_bytes):
+    """One kvstore push: raw gradient bytes vs post-compression wire
+    bytes (equal when no compressor is attached)."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    pushed = r.counter("graft_kvstore_push_bytes_total",
+                       "Raw bytes pushed into the kvstore")
+    pushed.inc(raw_bytes)
+    wire = r.counter("graft_kvstore_wire_bytes_total",
+                     "Bytes on the wire after gradient compression")
+    wire.inc(wire_bytes)
+    if wire.value() > 0:
+        r.gauge("graft_kvstore_compression_ratio",
+                "Cumulative push raw/wire byte ratio").set(
+            pushed.value() / wire.value())
+
+
+def kvstore_pull(nbytes):
+    if not enabled():
+        return
+    _REGISTRY.counter("graft_kvstore_pull_bytes_total",
+                      "Bytes pulled out of the kvstore").inc(nbytes)
+
+
+_io_rate = {}          # iterator name -> [last perf_counter, ewma rate]
+_io_lock = threading.Lock()
+
+
+def io_batch(iter_name):
+    """One data batch delivered by an io pipeline iterator; maintains a
+    batches/sec EWMA gauge per iterator class."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    r.counter("graft_io_batches_total",
+              "Batches delivered by io pipeline iterators",
+              ("iter",)).inc(iter=iter_name)
+    now = time.perf_counter()
+    with _io_lock:
+        st = _io_rate.get(iter_name)
+        if st is None:
+            _io_rate[iter_name] = [now, 0.0]
+            return
+        dt = now - st[0]
+        st[0] = now
+        if dt <= 0:
+            return
+        inst = 1.0 / dt
+        st[1] = inst if st[1] == 0.0 else 0.8 * st[1] + 0.2 * inst
+        rate = st[1]
+    r.gauge("graft_io_batches_per_sec",
+            "EWMA batches/sec per io iterator",
+            ("iter",)).set(rate, iter=iter_name)
+
+
+def autograd_backward(tape_len):
+    """Tape size at the start of a backward pass."""
+    if not enabled():
+        return
+    _REGISTRY.histogram("graft_autograd_tape_size",
+                        "Tape nodes walked per backward pass", (),
+                        buckets=_SEGMENT_LEN_BUCKETS).observe(tape_len)
+
+
+def phase(name, seconds):
+    """One training-loop phase (fwd/bwd/update/kvstore) completion."""
+    if not enabled():
+        return
+    _REGISTRY.histogram("graft_phase_seconds",
+                        "Training-loop phase latency", ("phase",),
+                        buckets=_PHASE_BUCKETS).observe(seconds, phase=name)
+
+
+def _collect_device_memory(reg):
+    """Snapshot-time gauges from the XLA per-device allocator (falls back
+    to live_arrays accounting — see profiler.device_memory)."""
+    from .. import profiler
+    g = reg.gauge("graft_device_memory_bytes",
+                  "Per-device memory from the storage accounting",
+                  ("device", "kind"))
+    for m in profiler.device_memory():
+        g.set(m["bytes_in_use"], device=m["device"], kind="in_use")
+        g.set(m["peak_bytes_in_use"], device=m["device"], kind="peak")
+        g.set(m["bytes_limit"], device=m["device"], kind="limit")
+
+
+def _collect_autograd_tape(reg):
+    from .. import autograd
+    reg.gauge("graft_autograd_tape_nodes",
+              "Live tape nodes on the calling thread").set(
+        len(autograd._st().tape))
+
+
+def _collect_engine_stats(reg):
+    """Mirror ``engine.flush_stats()`` so a snapshot is complete even if
+    a flush path bypassed the incremental counters (defensive sync —
+    values are authoritative from the engine's own dicts)."""
+    from .. import engine
+    stats = engine.flush_stats()
+    c = reg.counter("graft_engine_flushes_total",
+                    "Bulk-segment flushes by cause", ("cause",))
+    for cause, n in stats["causes"].items():
+        c.set(n, cause=cause)
+
+
+_REGISTRY.register_collector(_collect_device_memory)
+_REGISTRY.register_collector(_collect_autograd_tape)
+_REGISTRY.register_collector(_collect_engine_stats)
+
+
+def compact_snapshot(reg=None):
+    """Flat ``{"name{label=v}": value}`` view (histograms export their
+    ``_count``/``_sum``) — the form the benches embed in BENCH JSON."""
+    reg = reg or _REGISTRY
+    out = {}
+    reg._collect()
+    for m in reg.metrics():
+        for labels, payload in m.samples():
+            key = m.name + _fmt_labels(labels)
+            if m.kind == "histogram":
+                out[m.name + "_count" + _fmt_labels(labels)] = \
+                    payload["count"]
+                out[m.name + "_sum" + _fmt_labels(labels)] = \
+                    round(payload["sum"], 6)
+            else:
+                out[key] = payload
+    return out
+
+
+def write_snapshot(path, reg=None):
+    """Dump the JSON snapshot to ``path`` (GRAFT_TELEMETRY_SNAPSHOT)."""
+    reg = reg or _REGISTRY
+    with open(path, "w") as f:
+        json.dump(reg.snapshot(), f, indent=2, sort_keys=True)
+    return path

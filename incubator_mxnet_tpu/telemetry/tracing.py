@@ -1,0 +1,209 @@
+"""Segment-aware tracing: chrome-trace glue between the deferred engine
+and the profiler event stream.
+
+Since the engine executes *bulk segments* (PR 1) rather than individual
+ops, a per-op dispatch span times a ~0µs record and attributes nothing
+to the flush that actually runs the program.  graftscope fixes the
+attribution (the fusion-boundary view of "Operator Fusion in XLA",
+PAPERS.md):
+
+* every deferred op RECORD becomes a complete ("X") event with
+  ``args={"deferred": true, "segment": <id>}`` — its duration is the
+  record cost, never presented as op runtime;
+* every segment FLUSH becomes a span (``bulk_segment_flush``, cat
+  ``engine``) carrying cause / node count / program length / cache
+  hit-miss, with ``device_time: true`` when ``profiler.sync`` blocked
+  until ready (true device latency);
+* chrome-trace flow events (``ph: "s"`` at record, ``ph: "f"`` at
+  flush) link each deferred op to exactly one flush, so the trace UI
+  draws arrows from where an op was *issued* to where its cost *landed*.
+
+Also here: :func:`phase_span`, the per-batch training-loop span
+(fwd/bwd/update/kvstore) used by gluon ``Trainer`` and
+``Module.forward_backward`` — each span both lands in the chrome trace
+(cat ``phase``) and feeds the ``graft_phase_seconds`` histogram.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+
+from . import metrics as _metrics
+
+__all__ = ["phase_span", "next_segment_id", "record_active",
+           "deferred_op_event", "segment_flush_span",
+           "segment_summary", "validate_chrome_trace"]
+
+_segment_ids = itertools.count(1)
+
+FLOW_NAME = "bulk"
+FLOW_CAT = "engine.flow"
+SEGMENT_SPAN = "bulk_segment_flush"
+
+
+def next_segment_id():
+    return next(_segment_ids)
+
+
+def _prof():
+    from .. import profiler
+    return profiler
+
+
+def record_active():
+    """Whether deferred-op record events should be captured at all."""
+    p = _prof()
+    return p._P.active() and p.profile_imperative_enabled()
+
+
+def _flow_id(segment, index):
+    return "%d/%d" % (segment, index)
+
+
+def deferred_op_event(name, begin_us, end_us, segment, index):
+    """One deferred op record: the X event (marked deferred, owning
+    segment) + the flow start binding it to the segment flush."""
+    p = _prof()
+    p.record_event(name, begin_us, end_us,
+                   args={"deferred": True, "segment": segment})
+    p.append_raw_event({"name": FLOW_NAME, "cat": FLOW_CAT, "ph": "s",
+                        "id": _flow_id(segment, index), "ts": begin_us,
+                        "pid": 0, "tid": 0})
+
+
+def segment_flush_span(segment, cause, begin_us, end_us, flow_indices,
+                       program_len, live_outputs, cache_hit, recorded,
+                       device_time):
+    """The flush span + one flow finish per op that emitted a flow start
+    (``flow_indices`` — only those, so a profiler toggled mid-segment
+    never leaves a dangling arrow)."""
+    p = _prof()
+    p.record_event(SEGMENT_SPAN, begin_us, end_us, cat="engine",
+                   args={"segment": segment, "cause": cause,
+                         "nodes": program_len,
+                         "live_outputs": live_outputs,
+                         "cache": "hit" if cache_hit else "miss",
+                         "recorded": bool(recorded),
+                         "device_time": bool(device_time)})
+    # bind each flow to the enclosing flush slice (bp: "e")
+    ts = begin_us + min(1.0, max(end_us - begin_us, 0.0) / 2)
+    for i in flow_indices:
+        p.append_raw_event({"name": FLOW_NAME, "cat": FLOW_CAT, "ph": "f",
+                            "bp": "e", "id": _flow_id(segment, i),
+                            "ts": ts, "pid": 0, "tid": 0,
+                            "args": {"segment": segment}})
+
+
+class _PhaseSpan(object):
+    """Times one training-loop phase; emits a chrome event (cat "phase")
+    when the profiler runs and always feeds graft_phase_seconds."""
+
+    __slots__ = ("phase", "args", "_begin", "_t0")
+
+    def __init__(self, phase, args=None):
+        self.phase = phase
+        self.args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._begin = _prof()._now_us()
+        return self
+
+    def __exit__(self, *exc):
+        p = _prof()
+        if p._P.active():
+            args = {"phase": self.phase}
+            if self.args:
+                args.update(self.args)
+            p.record_event(self.phase, self._begin, p._now_us(),
+                           cat="phase", args=args)
+        _metrics.phase(self.phase, time.perf_counter() - self._t0)
+        return False
+
+
+class _NullSpan(object):
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+def phase_span(phase, args=None):
+    """Context manager for one fwd/bwd/update/kvstore phase.  Free when
+    both the profiler and telemetry are off."""
+    if not _metrics.enabled() and not _prof()._P.active():
+        return _NULL
+    return _PhaseSpan(phase, args)
+
+
+# ---------------------------------------------------------------------------
+# trace analysis (CLI + smoke-tier validation)
+# ---------------------------------------------------------------------------
+
+def segment_summary(events, top=10):
+    """Top-``top`` segment flushes by duration from a chrome-trace event
+    list, plus per-cause totals — the fusion-boundary attribution view."""
+    segs = [e for e in events
+            if e.get("name") == SEGMENT_SPAN and e.get("ph") == "X"]
+    segs.sort(key=lambda e: -e.get("dur", 0))
+    causes = {}
+    for e in segs:
+        c = e.get("args", {}).get("cause", "?")
+        agg = causes.setdefault(c, {"flushes": 0, "total_us": 0.0,
+                                    "nodes": 0})
+        agg["flushes"] += 1
+        agg["total_us"] += e.get("dur", 0)
+        agg["nodes"] += e.get("args", {}).get("nodes", 0)
+    return {
+        "top_segments": [{
+            "segment": e.get("args", {}).get("segment"),
+            "cause": e.get("args", {}).get("cause"),
+            "nodes": e.get("args", {}).get("nodes"),
+            "duration_us": round(e.get("dur", 0), 3),
+            "cache": e.get("args", {}).get("cache"),
+            "device_time": e.get("args", {}).get("device_time"),
+        } for e in segs[:top]],
+        "flush_causes_us": {c: round(v["total_us"], 3)
+                            for c, v in causes.items()},
+        "segments_total": len(segs),
+    }
+
+
+def validate_chrome_trace(trace):
+    """Schema + flow-link validation of a dumped trace dict.  Returns a
+    list of problems (empty == valid).  Used by the lint smoke tier."""
+    problems = []
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents missing or not a list"]
+    starts, finishes = {}, {}
+    for i, e in enumerate(events):
+        if not isinstance(e, dict) or "ph" not in e or "name" not in e:
+            problems.append("event %d: missing ph/name" % i)
+            continue
+        ph = e["ph"]
+        if ph in ("X", "s", "f", "i", "C") and "ts" not in e:
+            problems.append("event %d (%s): missing ts" % (i, ph))
+        if ph == "X" and e.get("dur", 0) < 0:
+            problems.append("event %d: negative dur" % i)
+        if ph == "s":
+            starts.setdefault(e.get("id"), []).append(i)
+        elif ph == "f":
+            finishes.setdefault(e.get("id"), []).append(i)
+    for fid, idxs in starts.items():
+        if len(idxs) != 1:
+            problems.append("flow id %r started %d times" % (fid, len(idxs)))
+        if fid not in finishes:
+            problems.append("flow id %r never finishes" % fid)
+    for fid, idxs in finishes.items():
+        if len(idxs) != 1:
+            problems.append("flow id %r finished %d times" % (fid, len(idxs)))
+        if fid not in starts:
+            problems.append("flow id %r finishes without a start" % fid)
+    return problems

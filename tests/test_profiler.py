@@ -132,6 +132,65 @@ def test_device_memory_accounting():
     assert "InUse(bytes)" in table
 
 
+def test_op_span_marks_deferred_records_under_bulking(tmp_path):
+    """Since graftscope, a deferred op's record event must not present
+    dispatch time as op duration: it is marked deferred with its owning
+    segment, and the cost lands on the bulk_segment_flush span."""
+    from incubator_mxnet_tpu import engine
+    fname = str(tmp_path / "profile_bulk.json")
+    profiler.dumps(reset=True)          # drop events leaked by prior tests
+    profiler.set_config(filename=fname, profile_imperative=True)
+    profiler.set_state("run")
+    a = nd.ones((16, 16))
+    with engine.bulk(16):
+        b = a * a
+        c = b + a
+        c.asnumpy()
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        events = json.load(f)["traceEvents"]
+    op_events = [e for e in events
+                 if e.get("cat") == "operator" and e["ph"] == "X"
+                 and e["name"] != "_ones"]
+    assert len(op_events) == 2
+    for e in op_events:
+        assert e["args"]["deferred"] is True
+        assert isinstance(e["args"]["segment"], int)
+    flushes = [e for e in events if e["name"] == "bulk_segment_flush"]
+    assert len(flushes) == 1
+    assert flushes[0]["args"]["segment"] == op_events[0]["args"]["segment"]
+    assert flushes[0]["args"]["nodes"] == 2
+    # eager path events carry the device_time attribution flag instead
+    eager = [e for e in events if e["name"] == "_ones"]
+    assert eager and eager[0]["args"]["device_time"] is False
+
+
+def test_executor_forward_span_device_time_attribution(tmp_path):
+    """Executor.forward gets the same treatment: its span says whether
+    the duration is async dispatch or true device latency (sync)."""
+    fname = str(tmp_path / "profile_exec_attr.json")
+    profiler.set_config(filename=fname, profile_symbolic=True)
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc_attr")
+    exe = fc.simple_bind(ctx=mx.cpu(), data=(2, 8), grad_req="null")
+    profiler.set_state("run")
+    exe.forward(is_train=False, data=nd.ones((2, 8)))
+    exe.outputs[0].asnumpy()
+    profiler.set_config(sync=True)
+    exe.forward(is_train=False, data=nd.ones((2, 8)))
+    profiler.set_config(sync=False)
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        events = json.load(f)["traceEvents"]
+    spans = [e for e in events
+             if e["name"].startswith("Executor.forward")]
+    assert len(spans) == 2
+    assert spans[0]["args"]["device_time"] is False
+    assert spans[1]["args"]["device_time"] is True
+
+
 def test_dumps_survives_marker_events():
     """Instant ('i') marker events have no duration — the aggregate table
     must skip them, not crash (review regression)."""

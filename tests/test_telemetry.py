@@ -1,0 +1,453 @@
+"""graftscope tests: segment-aware tracing + unified metrics registry.
+
+Covers the PR-3 acceptance surface: flow events link each deferred op to
+exactly one segment flush, sync-mode vs deferred-mode traces agree on op
+counts, the metrics snapshot round-trips through the Prometheus text
+format, and every instrumented subsystem (engine, kvstore, io, autograd,
+monitor, training loop) reports through the registry.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, engine, gluon, io, profiler
+from incubator_mxnet_tpu import telemetry
+from incubator_mxnet_tpu.telemetry import metrics as tmetrics
+from incubator_mxnet_tpu.telemetry import tracing as ttracing
+
+
+def _traced(fn, tmp_path, name="trace.json"):
+    """Run fn under the profiler, return the dumped trace events."""
+    fname = str(tmp_path / name)
+    profiler.set_config(filename=fname, profile_all=True)
+    profiler.set_state("run")
+    try:
+        fn()
+    finally:
+        profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        return json.load(f)["traceEvents"]
+
+
+def _chain(a):
+    b = a * a
+    c = b + a
+    d = c - a
+    return d
+
+
+# ---------------------------------------------------------------------------
+# tracing: flow links, schema, attribution
+# ---------------------------------------------------------------------------
+
+def test_flow_links_each_deferred_op_to_one_flush(tmp_path):
+    a = mx.nd.ones((8, 8))
+
+    def run():
+        with engine.bulk(16):
+            _chain(a).asnumpy()
+        with engine.bulk(16):
+            _chain(a).asnumpy()
+
+    events = _traced(run, tmp_path)
+    starts = [e for e in events if e.get("ph") == "s"]
+    finishes = [e for e in events if e.get("ph") == "f"]
+    deferred = [e for e in events
+                if e.get("args", {}).get("deferred") is True]
+    assert len(deferred) == 6          # 3 ops per scope, two scopes
+    assert len(starts) == 6 and len(finishes) == 6
+    # exactly one finish per start, ids match 1:1
+    assert sorted(e["id"] for e in starts) \
+        == sorted(e["id"] for e in finishes)
+    assert len({e["id"] for e in starts}) == 6
+    # each finish names the segment of exactly one flush span
+    seg_spans = {e["args"]["segment"]: e for e in events
+                 if e.get("name") == ttracing.SEGMENT_SPAN}
+    assert len(seg_spans) == 2
+    for f in finishes:
+        assert f["args"]["segment"] in seg_spans
+    # each deferred record points at its owning segment
+    for e in deferred:
+        assert e["args"]["segment"] in seg_spans
+    # schema-level validation agrees
+    assert ttracing.validate_chrome_trace({"traceEvents": events}) == []
+
+
+def test_segment_span_carries_attribution(tmp_path):
+    a = mx.nd.ones((4, 4))
+
+    def run():
+        with engine.bulk(16):
+            _chain(a).asnumpy()
+
+    events = _traced(run, tmp_path)
+    spans = [e for e in events if e.get("name") == ttracing.SEGMENT_SPAN]
+    assert len(spans) == 1
+    args = spans[0]["args"]
+    assert args["cause"] == "read"
+    assert args["nodes"] == 3
+    assert args["cache"] in ("hit", "miss")
+    assert args["recorded"] is False
+    assert spans[0]["dur"] >= 0
+    # deferred records must NOT present dispatch time as op duration:
+    # their events are explicitly marked
+    for e in events:
+        if e.get("cat") == "operator" and e.get("ph") == "X":
+            assert e["args"]["deferred"] is True
+
+
+def test_sync_and_deferred_traces_agree_on_op_counts(tmp_path):
+    a = mx.nd.ones((8, 8))
+    _chain(a).asnumpy()     # warm caches outside any trace
+
+    def eager():
+        _chain(a).asnumpy()
+
+    def bulked():
+        with engine.bulk(16):
+            _chain(a).asnumpy()
+
+    eager_events = _traced(eager, tmp_path, "eager.json")
+    bulk_events = _traced(bulked, tmp_path, "bulk.json")
+    eager_ops = sorted(e["name"] for e in eager_events
+                       if e.get("cat") == "operator" and e["ph"] == "X")
+    bulk_ops = sorted(e["name"] for e in bulk_events
+                      if e.get("cat") == "operator" and e["ph"] == "X")
+    assert eager_ops == bulk_ops
+    # and the eager ones are NOT marked deferred
+    for e in eager_events:
+        if e.get("cat") == "operator":
+            args = e.get("args") or {}
+            assert args.get("deferred") is not True
+            assert "segment" not in args
+
+
+def test_profiler_stopped_mid_segment_leaves_no_dangling_flow(tmp_path):
+    """Flow starts emitted at record time must be closed at flush even
+    if the profiler was deactivated in between (review fix)."""
+    a = mx.nd.ones((4, 4))
+    fname = str(tmp_path / "midstop.json")
+    profiler.dumps(reset=True)
+    profiler.set_config(filename=fname, profile_all=True)
+    profiler.set_state("run")
+    with engine.bulk(16):
+        b = a * a
+        profiler.set_state("stop")      # mid-segment
+        c = b + a                       # recorded, but not traced
+        c.asnumpy()                     # flush with profiler off
+    profiler.dump()
+    with open(fname) as f:
+        trace = json.load(f)
+    assert ttracing.validate_chrome_trace(trace) == []
+    starts = [e for e in trace["traceEvents"] if e.get("ph") == "s"]
+    finishes = [e for e in trace["traceEvents"] if e.get("ph") == "f"]
+    assert len(starts) == 1
+    assert sorted(e["id"] for e in starts) \
+        == sorted(e["id"] for e in finishes)
+
+
+def test_monitor_computes_concrete_stats_eagerly():
+    """Outside a bulk scope nothing is deferred: stat_helper must reduce
+    immediately instead of pinning the tensor until toc() (review fix)."""
+    from incubator_mxnet_tpu.monitor import Monitor
+    mon = Monitor(interval=1)
+    mon.tic()
+    arr = mx.nd.ones((4, 4))
+    arr.asnumpy()                       # concrete
+    mon.stat_helper("x_output0", arr)
+    (_step, _name, payload, lazy), = mon.queue
+    assert lazy is False
+    assert not hasattr(payload, "asnumpy") or payload.size == 1
+    entries = mon.toc()
+    assert len(entries) == 1 and float(entries[0][2]) == 1.0
+
+
+def test_prometheus_label_backslash_n_roundtrip():
+    reg = telemetry.MetricsRegistry()
+    tricky = "a\\nb"          # literal backslash + 'n', NOT a newline
+    reg.counter("esc_total", "t", ("p",)).inc(3, p=tricky)
+    parsed = telemetry.parse_prometheus_text(reg.prometheus_text())
+    assert parsed["esc_total"][frozenset({"p": tricky}.items())] == 3
+
+
+def test_sync_mode_flush_span_reports_device_time(tmp_path):
+    a = mx.nd.ones((8, 8))
+    fname = str(tmp_path / "sync.json")
+    profiler.set_config(filename=fname, profile_all=True, sync=True)
+    profiler.set_state("run")
+    try:
+        with engine.bulk(16):
+            _chain(a).asnumpy()
+    finally:
+        profiler.set_state("stop")
+        profiler.set_config(sync=False)
+    profiler.dump()
+    with open(fname) as f:
+        events = json.load(f)["traceEvents"]
+    spans = [e for e in events if e.get("name") == ttracing.SEGMENT_SPAN]
+    assert spans and all(e["args"]["device_time"] is True for e in spans)
+
+
+# ---------------------------------------------------------------------------
+# metrics registry: semantics + expositions
+# ---------------------------------------------------------------------------
+
+def test_registry_counter_gauge_histogram_basics():
+    reg = telemetry.MetricsRegistry()
+    c = reg.counter("test_total", "a counter", ("kind",))
+    c.inc(kind="x")
+    c.inc(2, kind="x")
+    c.inc(kind="y")
+    assert c.value(kind="x") == 3 and c.value(kind="y") == 1
+    with pytest.raises(ValueError):
+        c.inc(-1, kind="x")
+    g = reg.gauge("test_gauge", "a gauge")
+    g.set(5)
+    g.dec(2)
+    assert g.value() == 3
+    h = reg.histogram("test_seconds", "a histogram", buckets=(1, 10))
+    h.observe(0.5)
+    h.observe(5)
+    h.observe(50)
+    (_, payload), = h.samples()
+    assert payload["count"] == 3 and payload["sum"] == 55.5
+    assert payload["buckets"] == {"1": 1, "10": 2}
+    # same name, different kind → rejected
+    with pytest.raises(ValueError):
+        reg.gauge("test_total")
+
+
+def test_metrics_snapshot_roundtrips_prometheus_text():
+    reg = telemetry.MetricsRegistry()
+    reg.counter("rt_total", "ops", ("op", "ctx")).inc(
+        7, op='dot "big"', ctx="cpu(0)")
+    reg.gauge("rt_bytes", "bytes").set(12.5)
+    h = reg.histogram("rt_lat", "latency", ("phase",), buckets=(0.1, 1))
+    h.observe(0.05, phase="fwd")
+    h.observe(2.0, phase="fwd")
+    text = reg.prometheus_text()
+    parsed = telemetry.parse_prometheus_text(text)
+    assert parsed["rt_total"][
+        frozenset({"op": 'dot "big"', "ctx": "cpu(0)"}.items())] == 7
+    assert parsed["rt_bytes"][frozenset()] == 12.5
+    b = parsed["rt_lat_bucket"]
+    assert b[frozenset({"phase": "fwd", "le": "0.1"}.items())] == 1
+    assert b[frozenset({"phase": "fwd", "le": "1"}.items())] == 1
+    assert b[frozenset({"phase": "fwd", "le": "+Inf"}.items())] == 2
+    assert parsed["rt_lat_sum"][frozenset({"phase": "fwd"}.items())] \
+        == pytest.approx(2.05)
+    assert parsed["rt_lat_count"][frozenset({"phase": "fwd"}.items())] == 2
+    # the snapshot agrees with the wire values
+    snap = reg.snapshot()
+    assert snap["rt_total"]["samples"][0]["value"] == 7
+    assert snap["rt_lat"]["samples"][0]["value"]["count"] == 2
+
+
+def test_registry_absorbs_engine_flush_stats():
+    engine.reset_flush_stats()
+    a = mx.nd.ones((4, 4))
+    with engine.bulk(16):
+        (a + a).asnumpy()
+    with engine.bulk(2):
+        b = a + a
+        c = b + a
+        d = c + a          # size-cap flush
+        d.asnumpy()
+    stats = engine.flush_stats()
+    snap = telemetry.registry().snapshot()
+    mirrored = {s["labels"]["cause"]: s["value"]
+                for s in snap["graft_engine_flushes_total"]["samples"]}
+    for cause, n in stats["causes"].items():
+        assert mirrored[cause] == n
+    assert mirrored["read"] >= 1 and mirrored["size-cap"] >= 1
+    # reset keeps both views in step
+    engine.reset_flush_stats()
+    snap = telemetry.registry().snapshot()
+    assert all(s["value"] == 0
+               for s in snap["graft_engine_flushes_total"]["samples"])
+
+
+def test_telemetry_disable_switch():
+    reg = telemetry.registry()
+    c = reg.counter("switch_total", "t")
+    before = c.value()
+    telemetry.set_enabled(False)
+    try:
+        c.inc(5)
+        assert c.value() == before
+    finally:
+        telemetry.set_enabled(None)
+    c.inc(5)
+    assert c.value() == before + 5
+
+
+# ---------------------------------------------------------------------------
+# subsystem instrumentation
+# ---------------------------------------------------------------------------
+
+def test_kvstore_push_pull_bytes_and_compression():
+    reg = telemetry.registry()
+    kv = mx.kv.create("local")
+    shape = (64, 64)
+    kv.init("w", mx.nd.ones(shape))
+    push0 = reg.counter("graft_kvstore_push_bytes_total").value()
+    wire0 = reg.counter("graft_kvstore_wire_bytes_total").value()
+    pull0 = reg.counter("graft_kvstore_pull_bytes_total").value()
+    nb = 64 * 64 * 4
+    kv.push("w", mx.nd.ones(shape))
+    out = mx.nd.zeros(shape)
+    kv.pull("w", out=out)
+    assert reg.counter("graft_kvstore_push_bytes_total").value() \
+        - push0 == nb
+    assert reg.counter("graft_kvstore_wire_bytes_total").value() \
+        - wire0 == nb
+    assert reg.counter("graft_kvstore_pull_bytes_total").value() \
+        - pull0 == nb
+    # 2-bit compression: 16 elements per float32 word on the wire
+    kv2 = mx.kv.create("local")
+    kv2.init("g", mx.nd.zeros(shape))
+    kv2.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    p0 = reg.counter("graft_kvstore_push_bytes_total").value()
+    w0 = reg.counter("graft_kvstore_wire_bytes_total").value()
+    kv2.push("g", mx.nd.ones(shape))
+    assert reg.counter("graft_kvstore_push_bytes_total").value() - p0 == nb
+    assert reg.counter("graft_kvstore_wire_bytes_total").value() - w0 \
+        == nb // 16
+    ratio = reg.gauge("graft_kvstore_compression_ratio").value()
+    assert ratio > 1.0
+
+
+def test_io_batches_metrics():
+    reg = telemetry.registry()
+    data = np.random.rand(12, 3).astype(np.float32)
+    it = io.NDArrayIter(data=data, batch_size=4)
+    c = reg.counter("graft_io_batches_total", labelnames=("iter",))
+    before = c.value(iter="NDArrayIter")
+    n = sum(1 for _ in it)
+    assert n == 3
+    assert c.value(iter="NDArrayIter") - before == 3
+
+
+def test_autograd_tape_metrics():
+    reg = telemetry.registry()
+    h = reg.histogram("graft_autograd_tape_size")
+    samples = h.samples()
+    count0 = samples[0][1]["count"] if samples else 0
+    x = mx.nd.ones((4,))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    (_, payload), = h.samples()
+    assert payload["count"] == count0 + 1
+    assert payload["sum"] >= 2          # at least the two recorded ops
+
+
+def test_monitor_batches_stats_behind_one_flush():
+    """stat_helper must queue lazily; toc() materializes everything with
+    ONE engine flush tagged cause="monitor" (not per-array user reads)."""
+    from incubator_mxnet_tpu.monitor import Monitor
+    engine.reset_flush_stats()
+    mon = Monitor(interval=1)
+    mon.tic()
+    a = mx.nd.ones((4, 4))
+    with engine.bulk(32):
+        outs = []
+        x = a
+        for i in range(4):
+            x = x + a
+            outs.append(x)
+            mon.stat_helper("layer%d_output0" % i, x)
+        entries = mon.toc()
+    assert len(entries) == 4
+    for _step, _name, text in entries:
+        assert float(text) > 0
+    stats = engine.flush_stats()
+    assert stats["causes"]["monitor"] == 1
+    assert stats["causes"]["read"] == 0 and stats["causes"]["view"] == 0
+
+
+def test_trainer_step_emits_phase_spans(tmp_path):
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    x = mx.nd.ones((2, 8))
+    net(x).asnumpy()
+    kv = mx.kv.create("local")
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=kv)
+
+    def run():
+        with autograd.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        trainer.step(batch_size=2)
+
+    events = _traced(run, tmp_path)
+    phases = {e["name"] for e in events if e.get("cat") == "phase"}
+    assert {"bwd", "kvstore", "update"} <= phases
+    # and the histogram observed them
+    h = telemetry.registry()._metrics["graft_phase_seconds"]
+    observed = {labels["phase"] for labels, _ in h.samples()}
+    assert {"bwd", "kvstore", "update"} <= observed
+
+
+def test_module_forward_backward_phase_spans(tmp_path):
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    sym = mx.sym.SoftmaxOutput(fc, mx.sym.var("softmax_label"),
+                               name="softmax")
+    X = np.random.rand(8, 6).astype(np.float32)
+    y = np.zeros((8,), np.float32)
+    it = io.NDArrayIter(X, y, batch_size=8)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd")
+    batch = next(iter(it))
+
+    def run():
+        mod.forward_backward(batch)
+        mod.update()
+
+    events = _traced(run, tmp_path)
+    phases = {e["name"] for e in events if e.get("cat") == "phase"}
+    assert {"fwd", "bwd", "update"} <= phases
+
+
+# ---------------------------------------------------------------------------
+# snapshot completeness + CLI
+# ---------------------------------------------------------------------------
+
+def test_snapshot_includes_device_memory_gauges():
+    keep = mx.nd.zeros((256, 256))
+    keep.asnumpy()
+    snap = telemetry.registry().snapshot()
+    mems = snap["graft_device_memory_bytes"]["samples"]
+    kinds = {s["labels"]["kind"] for s in mems}
+    assert {"in_use", "peak", "limit"} <= kinds
+    in_use = [s["value"] for s in mems
+              if s["labels"]["kind"] == "in_use"]
+    assert any(v > 0 for v in in_use)
+    del keep
+
+
+def test_cli_selftest_passes():
+    from incubator_mxnet_tpu.telemetry.__main__ import selftest
+    assert selftest() == []
+
+
+def test_cli_summary_json(capsys):
+    """The acceptance path: one bulked gluon-Trainer step traced +
+    summarized with flush causes, kvstore bytes and device memory."""
+    from incubator_mxnet_tpu.telemetry.__main__ import main
+    assert main(["--summary", "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["segments_total"] >= 1
+    assert report["top_segments"]
+    assert any(v > 0 for v in report["flush_causes"].values())
+    assert report["kvstore_bytes"]["push_bytes"] > 0
+    assert report["device_memory"]
+    assert "graft_engine_flushes_total" in report["metrics"]

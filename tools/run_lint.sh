@@ -1,13 +1,20 @@
 #!/usr/bin/env bash
-# lint tier of the verify recipe: the op-contract static analyzer must be
-# clean (suppressed findings are allowed; unsuppressed ones fail the
-# build).  Thin wrapper over the canonical entry point — graftlint itself
-# pins jax to CPU and one pass produces both the human summary and the
-# machine-readable JSON report (for bench/verdict diagnostic tracking).
+# lint tier of the verify recipe, two sub-tiers:
+#
+# 1. graftlint — the op-contract static analyzer must be clean
+#    (suppressed findings are allowed; unsuppressed ones fail the build).
+#    Thin wrapper over the canonical entry point — graftlint itself pins
+#    jax to CPU and one pass produces both the human summary and the
+#    machine-readable JSON report (for bench/verdict diagnostic tracking).
+# 2. telemetry smoke — dump a chrome trace from a 3-op bulked program and
+#    validate the schema + record→flush flow links (graftscope); a trace
+#    regression exits non-zero just like a lint finding.
 #
 # Usage: tools/run_lint.sh [report.json]
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 REPORT="${1:-/tmp/graftlint_report.json}"
-exec python -m incubator_mxnet_tpu.analysis.graftlint --all --report "$REPORT"
+python -m incubator_mxnet_tpu.analysis.graftlint --all --report "$REPORT" \
+    || exit $?
+exec python -m incubator_mxnet_tpu.telemetry --selftest
