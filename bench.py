@@ -26,30 +26,55 @@ import numpy as np
 BASELINE_IMG_S = 90.74  # M40, ResNet-50 train batch 32 (docs/faq/perf.md:174)
 
 
+def _probe_backend():
+    """Run backend discovery in a side process under a hard timeout (it
+    inherits JAX_PLATFORMS, so a pinned platform is probed as pinned).
+    Returns the reported default backend, or "" on crash/hang."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True,
+            timeout=float(os.environ.get("BENCH_PROBE_TIMEOUT", "90")))
+        out = r.stdout.strip()
+        return out.splitlines()[-1] if r.returncode == 0 and out else ""
+    except Exception:
+        return ""
+
+
 def _resolve_backend():
     """Pick the jax platform BEFORE jax initializes in this process.
 
     On machines without a healthy TPU, backend discovery either raises
-    (BENCH_r05: rc=1 from ``jax.default_backend()`` via the axon plugin)
-    or hangs for minutes, so probe it in a side process under a hard
-    timeout and pin ``JAX_PLATFORMS=cpu`` unless the probe reports a
-    live TPU.  An operator-set JAX_PLATFORMS always wins."""
+    (BENCH_r05: rc=1, "Unable to initialize backend" from
+    ``jax.default_backend()`` via the axon plugin) or hangs for minutes —
+    and an operator-pinned ``JAX_PLATFORMS=tpu`` hits the same wall
+    in-process.  So: probe discovery in a side process under a hard
+    timeout (it inherits any pinned platform).  Unpinned, cpu is forced
+    unless the probe reports a live TPU (as before); pinned, the pin
+    wins whenever the probe SUCCEEDS (a healthy ``cuda`` pin stays
+    ``cuda``) and only a crashed/hung probe falls back to cpu.  A pinned
+    ``cpu`` skips the probe.  Belt-and-braces, the in-process query
+    still falls back to cpu on a backend-init error instead of crashing
+    the bench."""
     global _RESOLVED_BACKEND
-    if not os.environ.get("JAX_PLATFORMS"):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.default_backend())"],
-                capture_output=True, text=True,
-                timeout=float(os.environ.get("BENCH_PROBE_TIMEOUT", "90")))
-            out = r.stdout.strip()
-            probed = out.splitlines()[-1] if r.returncode == 0 and out else ""
-        except Exception:
-            probed = ""
-        if probed != "tpu":
+    pinned = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if pinned != "cpu":
+        probed = _probe_backend()
+        if (not probed) if pinned else (probed != "tpu"):
             os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
-    _RESOLVED_BACKEND = jax.default_backend()
+    try:
+        _RESOLVED_BACKEND = jax.default_backend()
+    except RuntimeError:
+        # the probe lied or raced: documented CPU fallback (discovery
+        # caches only successes, so the retry re-runs against cpu)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        _RESOLVED_BACKEND = jax.default_backend()
     return _RESOLVED_BACKEND
 
 

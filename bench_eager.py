@@ -19,8 +19,17 @@ This script quantifies all three on the current backend: a chain of
 small elementwise ops (the reference's worst case) run eagerly op-by-op,
 the same loop inside ``engine.bulk``, and the chain as one hybridized
 CachedOp.  Prints ONE JSON line with ops/sec for each.
+
+Round 4 adds the graftfuse step-latency section: a 64-small-param model
+stepped through ``gluon.Trainer`` on the per-param path (one optimizer
+kernel per parameter) vs the bucketed fused path (one multi-tensor
+dispatch per bucket) — the ratio lands in the BENCH JSON as
+``fused_step_speedup`` and the two paths are asserted bit-identical.
+``--smoke`` runs ONLY a fast version of that section (small iteration
+counts) so the lint tier exercises the bucketed path end-to-end.
 """
 import json
+import sys
 import time
 
 import numpy as np
@@ -29,6 +38,67 @@ import numpy as np
 CHAIN = 64          # ops per iteration (a*b+c, relu, sum-free chain)
 ITERS = 30
 SHAPE = (64, 64)
+
+FUSED_N_PARAMS = 64
+FUSED_SHAPE = (16, 16)
+
+
+def _fused_step_bench(iters=30, n_params=FUSED_N_PARAMS, shape=FUSED_SHAPE):
+    """Per-param vs bucketed Trainer.step over a many-small-param model.
+    Returns the metrics dict; asserts the two paths stay bit-identical
+    (the graftfuse contract) before reporting any speedup."""
+    import jax.numpy as jnp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+
+    def build(prefix):
+        rs = np.random.RandomState(0)
+        ps = []
+        for k in range(n_params):
+            p = gluon.Parameter("%s%d" % (prefix, k), shape=shape)
+            p.initialize(ctx=mx.cpu())
+            p.data()._write(jnp.asarray(rs.randn(*shape).astype(np.float32)))
+            p.grad()._write(jnp.asarray(rs.randn(*shape).astype(np.float32)))
+            ps.append(p)
+        return ps
+
+    opt_kw = {"learning_rate": 0.01, "momentum": 0.9}
+    pa, pb = build("pp"), build("bk")
+    per_param = gluon.Trainer(pa, "sgd", dict(opt_kw), kvstore=None)
+    per_param._bucket_bytes_override = 0        # force the per-param path
+    bucketed = gluon.Trainer(pb, "sgd", dict(opt_kw), kvstore=None)
+
+    def timed(trainer, params):
+        trainer.step(1)
+        params[-1].data().asnumpy()             # warm + sync
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            trainer.step(1)
+        params[-1].data().asnumpy()
+        return (time.perf_counter() - t0) / iters
+
+    dt_pp = timed(per_param, pa)
+    dt_bk = timed(bucketed, pb)
+    parity = all(a.data().asnumpy().tobytes() == b.data().asnumpy().tobytes()
+                 for a, b in zip(pa, pb))
+    assert parity, "bucketed Trainer.step diverged from the per-param path"
+    return {
+        "fused_step_params": n_params,
+        "fused_step_per_param_ms": round(dt_pp * 1e3, 3),
+        "fused_step_bucketed_ms": round(dt_bk * 1e3, 3),
+        "fused_step_speedup": round(dt_pp / dt_bk, 2),
+        "fused_step_parity": parity,
+    }
+
+
+def smoke():
+    """Fast path for the lint tier: exercise the bucketed step +
+    bit-parity assert in a few seconds, print one JSON line."""
+    import jax
+    res = _fused_step_bench(iters=3)
+    res["metric"] = "fused_step_smoke"
+    res["backend"] = jax.default_backend()
+    print(json.dumps(res))
 
 
 def _chain_eager(a, b, c, n):
@@ -168,7 +238,11 @@ def main():
     train_eager_ops = CHAIN * ITERS / dt_train_eager
     train_bulk_ops = CHAIN * ITERS / dt_train_bulk
 
+    # -- graftfuse: bucketed Trainer.step vs per-param (round 4) ---------
+    fused = _fused_step_bench(iters=ITERS)
+
     print(json.dumps({
+        **fused,
         "metric": "eager_small_op_dispatch",
         "backend": backend,
         "chain_len": CHAIN,
@@ -202,4 +276,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--smoke" in sys.argv[1:]:
+        smoke()
+    else:
+        main()

@@ -82,6 +82,7 @@ import os
 import threading
 import weakref
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -92,7 +93,9 @@ from .telemetry import metrics as _tmetrics
 from .telemetry import tracing as _ttracing
 
 __all__ = ["bulk", "flush", "flush_stats", "reset_flush_stats",
-           "EngineHazardError", "engine_check_enabled", "set_engine_check"]
+           "EngineHazardError", "engine_check_enabled", "set_engine_check",
+           "BoundedCache", "cache_sizes", "flatten_arrays", "unflatten",
+           "split_flat"]
 
 
 # --- strict-mode switch (GRAFT_ENGINE_CHECK=1) -----------------------------
@@ -195,11 +198,70 @@ class _BulkState(object):
         return slot
 
 
+class BoundedCache(object):
+    """Insertion/recency-ordered dict with size-bounded LRU eviction.
+
+    The engine's program caches (`_replay_cache`, `_infer_cache`,
+    `_seg_vjp_cache`) and the optimizer's fused-bucket-update cache grow
+    one entry per distinct program shape; a long-running trainer that
+    keeps changing shapes (dynamic batching, progressive resizing) would
+    otherwise hold every compiled program it ever built.  The bound is
+    ``GRAFT_REPLAY_CACHE_SIZE`` (default 1024; <= 0 means unbounded),
+    read at every insertion so tests and live sessions can re-tune it.
+    Eviction drops the least-recently-used entry — closures that already
+    captured an evicted value (e.g. a segment vjp held by live tape
+    nodes) keep working; only future lookups rebuild."""
+
+    DEFAULT_SIZE = 1024
+
+    def __init__(self, env="GRAFT_REPLAY_CACHE_SIZE"):
+        from collections import OrderedDict
+        self._env = env
+        self._d = OrderedDict()
+
+    def _bound(self):
+        try:
+            return int(os.environ.get(self._env, str(self.DEFAULT_SIZE)))
+        except ValueError:
+            return self.DEFAULT_SIZE
+
+    def get(self, key, default=None):
+        try:
+            self._d.move_to_end(key)
+        except KeyError:
+            return default
+        return self._d[key]
+
+    def __getitem__(self, key):
+        self._d.move_to_end(key)
+        return self._d[key]
+
+    def __setitem__(self, key, value):
+        self._d[key] = value
+        self._d.move_to_end(key)
+        bound = self._bound()
+        if bound > 0:
+            while len(self._d) > bound:
+                self._d.popitem(last=False)
+
+    def __contains__(self, key):
+        return key in self._d
+
+    def __iter__(self):
+        return iter(self._d)
+
+    def __len__(self):
+        return len(self._d)
+
+    def clear(self):
+        self._d.clear()
+
+
 _tls = threading.local()
-_replay_cache = {}
-_infer_cache = {}   # (op, input sig, params, train) -> output sig; shape
-# inference via jax.eval_shape costs ~a dispatch itself, so recording
-# would be slower than executing without this memo
+_replay_cache = BoundedCache()
+_infer_cache = BoundedCache()   # (op, input sig, params, train) -> output
+# sig; shape inference via jax.eval_shape costs ~a dispatch itself, so
+# recording would be slower than executing without this memo
 
 _FLUSH_CAUSES = ("scope-close", "size-cap", "view", "read", "autograd",
                  "monitor")
@@ -693,4 +755,54 @@ def flush(state=None, cause="read"):
                 pass
 
 
-_seg_vjp_cache = {}
+_seg_vjp_cache = BoundedCache()
+
+
+def cache_sizes():
+    """Current entry counts of the engine's bounded program caches (the
+    ``graft_engine_replay_cache_size`` gauge reads these)."""
+    return {"replay": len(_replay_cache),
+            "infer": len(_infer_cache),
+            "seg_vjp": len(_seg_vjp_cache),
+            "split": len(_split_cache)}
+
+
+# ---------------------------------------------------------------------------
+# shared flatten/unflatten glue (graftfuse)
+# ---------------------------------------------------------------------------
+# The bucketed Trainer.step path and the dist kvstore's dtype-grouped
+# allreduce both pack many small arrays into one flat buffer and back.
+# ONE jitted flattener (jax's jit cache specializes it per signature) and
+# one statically-sliced unflatten live here so the packing math exists in
+# exactly one place.
+
+@jax.jit
+def flatten_arrays(arrs):
+    """Concatenate a tuple of arrays into one flat buffer (one dispatch)."""
+    return jnp.concatenate([a.reshape(-1) for a in arrs])
+
+
+def unflatten(flat, shapes):
+    """Pure slicing of ``flat`` back into ``shapes`` — static offsets, so
+    it traces cleanly inside an outer jit (the fused optimizer programs
+    inline it; XLA fuses the slices away)."""
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    offs = np.concatenate([[0], np.cumsum(sizes)]).tolist()
+    return tuple(
+        jax.lax.slice(flat, (offs[i],), (offs[i + 1],)).reshape(shapes[i])
+        for i in range(len(shapes)))
+
+
+_split_cache = BoundedCache()
+
+
+def split_flat(flat, shapes):
+    """Eager companion of :func:`unflatten`: one cached jitted dispatch
+    that splits a flat buffer into per-shape arrays."""
+    shapes = tuple(tuple(s) for s in shapes)
+    key = (shapes, str(flat.dtype))
+    fn = _split_cache.get(key)
+    if fn is None:
+        fn = jax.jit(lambda f: unflatten(f, shapes))
+        _split_cache[key] = fn
+    return fn(flat)

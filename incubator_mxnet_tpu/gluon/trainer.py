@@ -4,13 +4,50 @@ Applies an Optimizer to a set of Parameters. When a KVStore is attached the
 gradient path mirrors the reference (trainer.py:156 _update → kvstore
 push/pull or update_on_kvstore); on a device mesh the same step lowers to
 psum-over-ICI via the parallel package instead of Comm/NCCL reductions.
+
+graftfuse (the bucketed step path): ``step`` no longer walks parameters
+one at a time.  Dense float parameters are greedily packed — in index
+order, per dtype — into flat buckets of ~``GRAFT_BUCKET_BYTES`` (default
+4 MiB); each bucket's gradients are concatenated into ONE buffer, reduced
+across contexts as one elementwise tree-sum and across workers as one
+collective (``KVStore.reduce_many`` → ``_cross_worker_reduce_many``), and
+applied through ONE jitted multi-tensor optimizer program per
+(optimizer-class, bucket signature) — ``optimizer.fused_bucket_update``.
+The whole step stays on device (no ``_read()`` round trips between reduce
+and update) and is bit-identical to the per-param path (the fused program
+runs the same registered op formulas element-for-element).  Per-param
+fallbacks: ``update_on_kvstore``, ``ignore_stale_grad``, gradient
+compression, store-side updaters, sparse grads, and optimizers without a
+fused kernel (anything but exact SGD/Adam).  One behavioral delta on the
+fused path: reduced gradients are consumed directly by the update and are
+NOT written back into ``param.list_grad()`` (``allreduce_grads()`` — the
+grad-accumulation API — keeps exact per-key write-back semantics).
 """
 from __future__ import annotations
 
+import os
+
+import numpy as np
+
+from .. import engine as _engine
 from .. import optimizer as opt
 from .parameter import ParameterDict, Parameter
 
 __all__ = ["Trainer"]
+
+_DEFAULT_BUCKET_BYTES = 4 << 20      # 4 MiB, the classic DDP bucket size
+
+
+class _Bucket(object):
+    """One (dtype, state-arity)-homogeneous gradient bucket of the fused
+    step plan."""
+    __slots__ = ("indices", "kind", "dtype", "nbytes")
+
+    def __init__(self, indices, kind, dtype, nbytes):
+        self.indices = tuple(indices)
+        self.kind = kind
+        self.dtype = dtype
+        self.nbytes = nbytes
 
 
 class Trainer(object):
@@ -115,7 +152,9 @@ class Trainer(object):
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Apply one optimization step with grads scaled by 1/batch_size
-        (ref: trainer.py:156 step)."""
+        (ref: trainer.py:156 step).  Takes the bucketed fused path when
+        the plan allows it; falls back to the (batched) per-param path
+        otherwise — both produce bit-identical parameters."""
         # rescale BEFORE the kvstore handshake: update_on_kvstore ships a
         # pickled optimizer to the server exactly once, so the first
         # step's scaling must already be on it (reference limitation too:
@@ -123,11 +162,18 @@ class Trainer(object):
         self._optimizer.rescale_grad = self._scale / batch_size
         if not self._kv_initialized:
             self._init_kvstore()
+        plan = None if ignore_stale_grad else self._fused_plan()
         from ..telemetry import tracing as _ttracing
         with _ttracing.phase_span("kvstore"):
-            self._allreduce_grads()
+            if plan is None:
+                self._allreduce_grads()
+            else:
+                reduced = self._bucketed_allreduce(plan)
         with _ttracing.phase_span("update"):
-            self._update(ignore_stale_grad)
+            if plan is None:
+                self._update(ignore_stale_grad)
+            else:
+                self._bucketed_update(plan, reduced)
 
     def allreduce_grads(self):
         """ref: trainer.py allreduce_grads (1.3+, for grad accumulation)."""
@@ -138,11 +184,17 @@ class Trainer(object):
     def _allreduce_grads(self):
         if self._kvstore_obj is None:
             return
-        for i, param in enumerate(self._params):
-            if param.grad_req != "null":
-                self._kvstore_obj.push(i, param.list_grad(), priority=-i)
-                if not self._update_on_kvstore:
-                    self._kvstore_obj.pull(i, param.list_grad(), priority=-i)
+        # one batched multi-key push/pull: a single fused dist collective
+        # for the whole gradient set instead of one round per key (the
+        # batching role of kvstore_dist.h's big-array sharding)
+        keys = [i for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        if not keys:
+            return
+        grads = [self._params[i].list_grad() for i in keys]
+        self._kvstore_obj.push_many(keys, grads)
+        if not self._update_on_kvstore:
+            self._kvstore_obj.pull_many(keys, grads)
 
     def update(self, batch_size, ignore_stale_grad=False):
         """ref: trainer.py update (apply updates without reduce)."""
@@ -153,13 +205,156 @@ class Trainer(object):
 
     def _update(self, ignore_stale_grad=False):
         if self._kvstore_obj is not None and self._update_on_kvstore:
-            for i, param in enumerate(self._params):
-                if param.grad_req != "null":
-                    self._kvstore_obj.pull(i, param.list_data(), priority=-i)
+            keys = [i for i, p in enumerate(self._params)
+                    if p.grad_req != "null"]
+            if keys:
+                self._kvstore_obj.pull_many(
+                    keys, [self._params[i].list_data() for i in keys])
             return
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
+            for upd, arr, grad in zip(self._updaters, param.list_data(),
+                                      param.list_grad()):
+                upd(i, grad, arr)
+
+    # -- graftfuse: the bucketed step path ---------------------------------
+    _bucket_bytes_override = None     # tests/benches force a target here
+
+    def _bucket_target_bytes(self):
+        if self._bucket_bytes_override is not None:
+            return int(self._bucket_bytes_override)
+        try:
+            return int(os.environ.get("GRAFT_BUCKET_BYTES",
+                                      str(_DEFAULT_BUCKET_BYTES)))
+        except ValueError:
+            return _DEFAULT_BUCKET_BYTES
+
+    def _fused_plan(self):
+        """The bucket plan for the current configuration, or None when
+        step() must take the per-param path wholesale.  Cached against a
+        signature of everything the plan depends on, so steady-state
+        steps pay one tuple comparison."""
+        target = self._bucket_target_bytes()
+        kv = self._kvstore_obj
+        if target <= 0 or self._update_on_kvstore \
+                or (kv is not None and (kv._compressor is not None
+                                        or kv._updater is not None)):
+            return None
+        optimizer = self._optimizer
+        # per-param state arity rides in the signature AND the bucket
+        # key: existing states keep the formula they were created with
+        # (e.g. momentum flipped mid-run only affects states created
+        # afterwards, exactly like the per-param path), so a fused
+        # program must never mix arities
+        states0 = self._updaters[0].states
+        kinds, arities = [], []
+        for i, p in enumerate(self._params):
+            kind = opt.fused_bucket_kind(optimizer, p.dtype) \
+                if p.grad_req != "null" else None
+            kinds.append(kind)
+            arities.append(None if kind is None else (
+                opt.fused_state_arity(optimizer, kind, states0[i])
+                if i in states0 else opt.fused_state_arity(optimizer, kind)))
+        sig = (target, type(optimizer), bool(optimizer.multi_precision),
+               getattr(optimizer, "momentum", None), tuple(arities),
+               len(self._contexts), kv is not None,
+               tuple((str(p.dtype), p.shape, p.grad_req, p._stype,
+                      p._grad_stype) for p in self._params))
+        cached = getattr(self, "_fused_plan_cache", None)
+        if cached is not None and cached[0] == sig:
+            return cached[1]
+        open_buckets = {}       # (dtype, arity) -> (indices, nbytes)
+        buckets, leftover = [], []
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            kind = kinds[i]
+            dense = p._stype == "default" and p._grad_stype == "default"
+            known = p.shape is not None and int(np.prod(p.shape)) > 0
+            if kind is None or not dense or not known:
+                leftover.append(i)
+                continue
+            dt = np.dtype(p.dtype)
+            bkey = (dt, arities[i])
+            nbytes = int(np.prod(p.shape)) * dt.itemsize
+            idxs, total = open_buckets.setdefault(bkey, ([], 0))
+            idxs.append(i)
+            total += nbytes
+            if total >= target:
+                buckets.append(_Bucket(idxs, kind, dt, total))
+                open_buckets.pop(bkey)
+            else:
+                open_buckets[bkey] = (idxs, total)
+        for (dt, _arity), (idxs, total) in open_buckets.items():
+            buckets.append(_Bucket(idxs, opt.fused_bucket_kind(
+                optimizer, dt), dt, total))
+        plan = (buckets, leftover) if buckets else None
+        self._fused_plan_cache = (sig, plan)
+        if plan is not None:
+            from ..telemetry import metrics as _tmetrics
+            _tmetrics.trainer_buckets([b.nbytes for b in buckets],
+                                      len(leftover))
+        return plan
+
+    def _bucketed_allreduce(self, plan):
+        """Reduce every bucket's gradients with ONE concatenated buffer
+        per bucket: contexts tree-sum elementwise (the same addition
+        order as KVStore._reduce), workers allreduce through
+        ``KVStore.reduce_many`` in one fused collective.  Returns
+        {id(bucket): flat reduced NDArray}; empty when there is no store
+        (the fused update then reads the per-param grads directly)."""
+        from ..ndarray import NDArray
+        buckets, leftover = plan
+        kv = self._kvstore_obj
+        if kv is not None and leftover:
+            grads = [self._params[i].list_grad() for i in leftover]
+            kv.push_many(leftover, grads)
+            kv.pull_many(leftover, grads)
+        if kv is None:
+            return {}
+        flats = []
+        for b in buckets:
+            per_ctx = [
+                _engine.flatten_arrays(tuple(
+                    self._params[i].list_grad()[j]._read()
+                    for i in b.indices))
+                for j in range(len(self._contexts))]
+            acc = per_ctx[0]
+            for f in per_ctx[1:]:
+                acc = acc + f
+            flats.append(NDArray(acc, ctx=self._contexts[0]))
+        kv.reduce_many(flats)
+        return {id(b): nd for b, nd in zip(buckets, flats)}
+
+    def _bucketed_update(self, plan, reduced):
+        """One fused multi-tensor optimizer dispatch per (bucket,
+        context); leftover params take the per-param updater."""
+        buckets, leftover = plan
+        optimizer = self._optimizer
+        n_ctx = len(self._contexts)
+        for b in buckets:
+            # bookkeeping ticks in the exact per-param order (param
+            # outer, context inner) so update counts, schedulers and
+            # Adam's bias correction see the same sequence
+            lrs = [[0.0] * len(b.indices) for _ in range(n_ctx)]
+            wds = [[0.0] * len(b.indices) for _ in range(n_ctx)]
+            for pos, i in enumerate(b.indices):
+                for j in range(n_ctx):
+                    lr, wd = opt.fused_lr_wd(optimizer, i, b.kind)
+                    lrs[j][pos] = lr
+                    wds[j][pos] = wd
+            flat = reduced.get(id(b))
+            for j in range(n_ctx):
+                weights = [self._params[i].list_data()[j]
+                           for i in b.indices]
+                grads = None if flat is not None else \
+                    [self._params[i].list_grad()[j] for i in b.indices]
+                opt.fused_bucket_update(optimizer, self._updaters[j],
+                                        b.indices, weights, grads,
+                                        lrs[j], wds[j], flat_grad=flat)
+        for i in leftover:
+            param = self._params[i]
             for upd, arr, grad in zip(self._updaters, param.list_data(),
                                       param.list_grad()):
                 upd(i, grad, arr)

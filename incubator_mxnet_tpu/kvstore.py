@@ -148,6 +148,35 @@ class KVStore(object):
         fused collective over all values; mutates them in place)."""
         return reds
 
+    def push_many(self, keys, values, priority=0):
+        """Batched multi-key push: one call, one fused cross-worker
+        collective for the whole key list (the batching contract of the
+        reference's big-array sharding, kvstore_dist.h — here the
+        amortization is key-batching).  ``push`` already accepts key
+        lists; this spelling is the Trainer-facing API that guarantees
+        the single-collective behavior."""
+        return self.push(list(keys), list(values), priority=priority)
+
+    def pull_many(self, keys, outs, priority=0):
+        """Batched multi-key pull (companion of :meth:`push_many`)."""
+        return self.pull(list(keys), outs, priority=priority)
+
+    def reduce_many(self, values):
+        """Reduce a list of dense NDArrays across workers IN PLACE with
+        as few collectives as possible (one per dtype group on the dist
+        wire) and return them.  This is the raw bucket wire the fused
+        Trainer.step path rides: no per-key store bookkeeping, no
+        server-side updater — just the allreduce.  Single-process stores
+        have nothing to reduce, but the push/pull byte counters still
+        observe the payload so fused vs per-param runs report comparable
+        kvstore telemetry."""
+        if not values:
+            return values
+        raw = sum(_nd_bytes(v) for v in values)
+        _tmetrics.kvstore_push(raw, raw)
+        _tmetrics.kvstore_pull(raw)
+        return self._cross_worker_reduce_many(list(values))
+
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast store value into out list (ref: KVStore::Pull)."""
         assert out is not None
@@ -156,9 +185,14 @@ class KVStore(object):
         for k, olist in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("key %s has not been initialized" % k)
-            src = self._store[k]
+            # hoist the store read out of the replica loop, and skip the
+            # astype copy when dtypes already match — the common Trainer
+            # pull (grad -> grad, same dtype) is then a pure rebind
+            val = self._store[k]._read()
+            src_dtype = np.dtype(val.dtype)
             for o in olist:
-                o._write(src._read().astype(o.dtype))
+                o._write(val if np.dtype(o.dtype) == src_dtype
+                         else val.astype(o.dtype))
                 pulled += _nd_bytes(o)
         _tmetrics.kvstore_pull(pulled)
 

@@ -19,14 +19,18 @@ import pickle
 import numpy as np
 
 from .base import MXNetError
+from .engine import BoundedCache, unflatten
 from .ndarray import NDArray, invoke
 from .ndarray import ndarray as _nd_mod
 from .ops.registry import get_op
+import jax
 import jax.numpy as jnp
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "AdaDelta", "RMSProp",
            "Ftrl", "FTML", "Signum", "SGLD", "DCASGD", "LBSGD", "Test",
-           "create", "register", "get_updater", "Updater"]
+           "create", "register", "get_updater", "Updater",
+           "fused_bucket_kind", "fused_bucket_update", "fused_lr_wd",
+           "fused_state_arity"]
 
 
 class Optimizer(object):
@@ -578,14 +582,22 @@ class Updater(object):
         self.states = {}
         self.states_synced = {}
 
-    def __call__(self, index, grad, weight):
+    def ensure_state(self, index, weight):
+        """Create (or context-sync) the state for ``index`` exactly as
+        ``__call__`` would.  The fused bucket-update path (graftfuse)
+        shares this per-index store, so save_states/load_states and
+        switching between fused and per-param execution stay seamless."""
         if index not in self.states:
             self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
             self.states_synced[index] = True
         elif not self.states_synced[index]:
             self.states[index] = self.sync_state_context(self.states[index], weight.context)
             self.states_synced[index] = True
-        self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
+        return self.states[index]
+
+    def __call__(self, index, grad, weight):
+        state = self.ensure_state(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad, state)
 
     def sync_state_context(self, state, context):
         if isinstance(state, NDArray):
@@ -624,3 +636,225 @@ class Updater(object):
 def get_updater(optimizer):
     """ref: optimizer.py get_updater."""
     return Updater(optimizer)
+
+
+# ---------------------------------------------------------------------------
+# graftfuse: multi-tensor fused bucket updates
+# ---------------------------------------------------------------------------
+# The per-param path dispatches one optimizer kernel per (param, context) —
+# N tiny XLA programs per step, each crossing the host via invoke().  The
+# fused path compiles ONE jitted program per (optimizer-class, bucket
+# signature) that updates every parameter of a dtype-homogeneous bucket in
+# a single dispatch: gradients arrive either as the bucket's flat reduced
+# buffer (sliced/unflattened inside the program — free under XLA fusion)
+# or as the per-param arrays, the per-param update formulas are the exact
+# registered op fcomputes (sgd_update / sgd_mom_update / mp_* / adam_update),
+# and the outputs rebind each weight/state NDArray without any device work.
+# lr / wd / rescale_grad are baked into the program as constants — the
+# same layout the per-param jits use, which is what makes the fused
+# programs compile (and round) identically to the standalone ones; the
+# cache key includes them, mirroring the per-param Operator.bind cache
+# that also keys on these scalars.  Bit-exactness with the per-param path
+# holds because every element goes through the same elementwise op chain
+# with the same constant structure (tests/test_trainer_fused.py pins this
+# down byte-for-byte).  Cached like the engine's _replay_cache, with the
+# same GRAFT_REPLAY_CACHE_SIZE bound.
+
+_FUSED_STEP_CACHE = BoundedCache()
+
+_HALF_DTYPES = (np.dtype("float16"), np.dtype("bfloat16"))
+
+
+def fused_bucket_kind(optimizer, dtype):
+    """Fused-program tag for parameters of ``dtype`` under ``optimizer``,
+    or None when that combination must take the per-param path.  Exact
+    type checks (not isinstance): a subclass may override update() and
+    silently diverge from the fused formula."""
+    dtype = np.dtype(dtype)
+    if not jnp.issubdtype(dtype, jnp.floating):
+        return None
+    if type(optimizer) is SGD:
+        if optimizer.multi_precision and dtype in _HALF_DTYPES:
+            return "mp_sgd"
+        return "sgd"
+    if type(optimizer) is Adam:
+        if optimizer.multi_precision and dtype in _HALF_DTYPES:
+            return None     # base-class mp wrapper: keep per-param
+        return "adam"
+    return None
+
+
+def fused_lr_wd(optimizer, index, kind):
+    """One per-(param, context) bookkeeping tick in the exact per-param
+    sequence: bump the update count, then resolve lr (with Adam's bias
+    correction folded in, as Adam.update does) and wd."""
+    optimizer._update_count(index)
+    lr = optimizer._get_lr(index)
+    if kind == "adam":
+        t = optimizer._index_update_count[index]
+        lr *= math.sqrt(1.0 - optimizer.beta2 ** t) \
+            / (1.0 - optimizer.beta1 ** t)
+    return lr, optimizer._get_wd(index)
+
+
+def _fused_state_arrays(kind, state):
+    """The NDArray leaves of one per-index state, in program order."""
+    if kind == "sgd":
+        return () if state is None else (state,)
+    if kind == "mp_sgd":
+        mom, weight32 = state
+        return (weight32,) if mom is None else (mom, weight32)
+    if kind == "adam":
+        mean, var = state
+        return (mean, var)
+    raise ValueError("unknown fused kind %r" % kind)
+
+
+_NO_STATE = object()
+
+
+def fused_state_arity(optimizer, kind, state=_NO_STATE):
+    """State-leaf count a param contributes to a fused program — from its
+    EXISTING per-index state when one exists (the per-param formulas key
+    off the state object, not current config: a momentum flipped mid-run
+    only affects states created afterwards), else from the optimizer's
+    current config.  The Trainer plan buckets by (dtype, arity) so a
+    fused program never mixes formula variants."""
+    if state is not _NO_STATE:
+        return len(_fused_state_arrays(kind, state))
+    if kind == "sgd":
+        return 1 if optimizer.momentum else 0
+    if kind == "mp_sgd":
+        return 2 if optimizer.momentum else 1
+    return 2    # adam: (mean, var)
+
+
+def _fused_config(optimizer, kind):
+    """Static (hashable) config baked into the fused program — part of
+    the cache key; everything per-step stays a traced operand."""
+    clip = optimizer.clip_gradient
+    clip = -1.0 if clip is None else float(clip)
+    if kind in ("sgd", "mp_sgd"):
+        return (float(optimizer.momentum), clip)
+    if kind == "adam":
+        return (float(optimizer.beta1), float(optimizer.beta2),
+                float(optimizer.epsilon), clip)
+    raise ValueError("unknown fused kind %r" % kind)
+
+
+def _build_fused_program(kind, cfg, shapes, flat_mode, has_state,
+                         lrs, wds, rescale):
+    """One unflatten→update→reflatten program over a whole bucket.
+
+    lr/wd/rescale are baked in as python-float CONSTANTS, exactly as the
+    per-param path bakes them into each op's jitted partial — traced
+    scalar operands occasionally shift LLVM's fma-contraction choices by
+    1 ULP (measured on bf16 mp_sgd), and constants are the only layout
+    that compiles each param's formula identically to its standalone
+    program.  The per-param ``Operator.bind`` cache keys on the same
+    scalars, so a changing lr schedule costs the fused path exactly the
+    retraces it already cost the per-param path."""
+    if kind in ("sgd", "mp_sgd"):
+        momentum, clip = cfg
+    else:
+        beta1, beta2, epsilon, clip = cfg
+    sgd_fc = get_op("sgd_update").fcompute
+    sgd_mom_fc = get_op("sgd_mom_update").fcompute
+    mp_sgd_fc = get_op("mp_sgd_update").fcompute
+    mp_sgd_mom_fc = get_op("mp_sgd_mom_update").fcompute
+    adam_fc = get_op("adam_update").fcompute
+
+    def step(weights, grads, states):
+        gs = unflatten(grads, shapes) if flat_mode else grads
+        new_w, new_s = [], []
+        for k, w in enumerate(weights):
+            g = gs[k]
+            lr, wd, st = lrs[k], wds[k], states[k]
+            if kind == "sgd":
+                if has_state:
+                    w2, m2 = sgd_mom_fc(w, g, st[0], lr=lr,
+                                        momentum=momentum, wd=wd,
+                                        rescale_grad=rescale,
+                                        clip_gradient=clip)
+                    new_w.append(w2)
+                    new_s.append((m2,))
+                else:
+                    new_w.append(sgd_fc(w, g, lr=lr, wd=wd,
+                                        rescale_grad=rescale,
+                                        clip_gradient=clip))
+                    new_s.append(())
+            elif kind == "mp_sgd":
+                if has_state:
+                    w2, m2, w32 = mp_sgd_mom_fc(w, g, st[0], st[1], lr=lr,
+                                                momentum=momentum, wd=wd,
+                                                rescale_grad=rescale,
+                                                clip_gradient=clip)
+                    new_w.append(w2)
+                    new_s.append((m2, w32))
+                else:
+                    w2, w32 = mp_sgd_fc(w, g, st[0], lr=lr, wd=wd,
+                                        rescale_grad=rescale,
+                                        clip_gradient=clip)
+                    new_w.append(w2)
+                    new_s.append((w32,))
+            else:
+                w2, m2, v2 = adam_fc(w, g, st[0], st[1], lr=lr,
+                                     beta1=beta1, beta2=beta2,
+                                     epsilon=epsilon, wd=wd,
+                                     rescale_grad=rescale,
+                                     clip_gradient=clip)
+                new_w.append(w2)
+                new_s.append((m2, v2))
+        return tuple(new_w), tuple(new_s)
+
+    return jax.jit(step)
+
+
+def fused_bucket_update(optimizer, updater, indices, weights, grads,
+                        lrs, wds, flat_grad=None):
+    """Apply one fused multi-tensor optimizer step to a bucket on one
+    context: ``indices``/``weights`` are the bucket's params (index
+    order), ``grads`` their per-param gradient NDArrays (ignored when
+    ``flat_grad`` — the bucket's reduced flat buffer — is given), and
+    ``lrs``/``wds`` the per-param scalars the caller resolved via
+    :func:`fused_lr_wd`.  States come from (and go back to) ``updater``'s
+    per-index store.  Everything stays on device: one jit dispatch, then
+    pure buffer rebinds."""
+    from .telemetry import metrics as _tmetrics
+    kind = fused_bucket_kind(optimizer, weights[0].dtype)
+    assert kind is not None, "caller must pre-check fused_bucket_kind"
+    state_arrays = [
+        _fused_state_arrays(kind, updater.ensure_state(i, w))
+        for i, w in zip(indices, weights)]
+    arity = len(state_arrays[0])
+    # the Trainer plan buckets by (dtype, state arity); a mixed bucket
+    # here means the plan went stale relative to the state store
+    assert all(len(s) == arity for s in state_arrays), \
+        "fused bucket with heterogeneous state arity — plan is stale"
+    # "has_state" selects the momentum variant of the sgd/mp_sgd program
+    # (mp always carries the f32 master copy, so momentum means 2 leaves)
+    has_state = arity >= (2 if kind == "mp_sgd" else 1)
+    cfg = _fused_config(optimizer, kind)
+    shapes = tuple(tuple(w.shape) for w in weights)
+    dtype = np.dtype(weights[0].dtype)
+    flat_mode = flat_grad is not None
+    lrs = tuple(float(v) for v in lrs)
+    wds = tuple(float(v) for v in wds)
+    rescale = float(optimizer.rescale_grad)
+    key = (kind, cfg, shapes, str(dtype), flat_mode, has_state,
+           lrs, wds, rescale)
+    fn = _FUSED_STEP_CACHE.get(key)
+    if fn is None:
+        fn = _build_fused_program(kind, cfg, shapes, flat_mode, has_state,
+                                  lrs, wds, rescale)
+        _FUSED_STEP_CACHE[key] = fn
+    wvals = tuple(w._read() for w in weights)
+    gvals = flat_grad._read() if flat_mode \
+        else tuple(g._read() for g in grads)
+    svals = tuple(tuple(a._read() for a in arrs) for arrs in state_arrays)
+    outs_w, outs_s = fn(wvals, gvals, svals)
+    for k, w in enumerate(weights):
+        w._write(outs_w[k])
+        for arr, val in zip(state_arrays[k], outs_s[k]):
+            arr._write(val)
+    _tmetrics.trainer_fused_update(len(weights))

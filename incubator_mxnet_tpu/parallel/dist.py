@@ -385,10 +385,14 @@ class DistKVStore(KVStore):
                 self._cross_worker_reduce_sparse(r)    # row-id union path
             else:
                 groups.setdefault(np.dtype(r.dtype), []).append(r)
+        # pack/unpack glue is jitted (engine.flatten_arrays / split_flat)
+        # so an N-value push costs 2 dispatches of host glue instead of
+        # ~2N (one ravel per value + one slice per write-back)
+        from .. import engine as _engine
         compress = (self._compressor is not None)
         for dtype, group in groups.items():
             vals = [r._read() for r in group]
-            flat = jnp.concatenate([v.ravel() for v in vals])
+            flat = _engine.flatten_arrays(tuple(vals))
             if compress and np.issubdtype(dtype, np.floating):
                 # the push already quantized values to {-t, 0, +t}
                 # (residual kept worker-side); the wire is a compressed
@@ -405,11 +409,9 @@ class DistKVStore(KVStore):
                     words, t, flat.shape[0], worker_mesh()).astype(flat.dtype)
             else:
                 summed = _global_sum(flat)
-            off = 0
-            for r, v in zip(group, vals):
-                n = int(np.prod(v.shape))
-                r._write(summed[off:off + n].reshape(v.shape))
-                off += n
+            pieces = _engine.split_flat(summed, [v.shape for v in vals])
+            for r, piece in zip(group, pieces):
+                r._write(piece)
         return reds
 
     def _sync_set_optimizer(self, optimizer):

@@ -509,6 +509,49 @@ def _collect_engine_stats(reg):
                     "Bulk-segment flushes by cause", ("cause",))
     for cause, n in stats["causes"].items():
         c.set(n, cause=cause)
+    g = reg.gauge("graft_engine_replay_cache_size",
+                  "Entries in the engine's bounded program caches "
+                  "(GRAFT_REPLAY_CACHE_SIZE)", ("cache",))
+    for name, n in engine.cache_sizes().items():
+        g.set(n, cache=name)
+    from .. import optimizer as _opt
+    g.set(len(_opt._FUSED_STEP_CACHE), cache="fused_update")
+
+
+_BUCKET_BYTE_BUCKETS = (4096, 65536, 262144, 1 << 20, 4 << 20, 16 << 20,
+                        64 << 20)
+
+
+def trainer_buckets(bucket_bytes_list, n_leftover):
+    """One bucket plan build by the fused Trainer.step path: bucket count
+    gauge + per-bucket payload-bytes histogram (graftfuse)."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    r.gauge("graft_trainer_bucket_count",
+            "Gradient buckets in the current fused-step plan").set(
+        len(bucket_bytes_list))
+    r.gauge("graft_trainer_bucket_leftover_params",
+            "Params the fused-step plan left on the per-param path").set(
+        n_leftover)
+    h = r.histogram("graft_trainer_bucket_bytes",
+                    "Payload bytes per gradient bucket", (),
+                    buckets=_BUCKET_BYTE_BUCKETS)
+    for nb in bucket_bytes_list:
+        h.observe(nb)
+
+
+def trainer_fused_update(n_params):
+    """One fused multi-tensor optimizer dispatch (per bucket, per
+    context); latency lands on the existing ``update`` phase span."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    r.counter("graft_trainer_bucket_fused_updates_total",
+              "Fused multi-tensor optimizer update dispatches").inc()
+    r.counter("graft_trainer_bucket_fused_params_total",
+              "Parameters updated through fused bucket dispatches").inc(
+        n_params)
 
 
 _REGISTRY.register_collector(_collect_device_memory)

@@ -1,0 +1,367 @@
+"""graftfuse: bucketed Trainer.step must be BIT-IDENTICAL to the
+per-param path.
+
+The fused path groups dense float params into dtype-homogeneous flat
+buckets, reduces each bucket's gradients with one concatenated collective
+and applies one jitted multi-tensor optimizer program per bucket
+(gluon/trainer.py, optimizer.fused_bucket_update).  Because the fused
+programs run the exact registered op formulas element-for-element with
+scalar operands that compile identically to the per-param constants, the
+parity contract is bytes-equality on weights AND optimizer states — not
+allclose.  Also here: the kvstore multi-key push/pull batching parity and
+the GRAFT_REPLAY_CACHE_SIZE bound on the engine program caches.
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import engine, gluon
+import jax.numpy as jnp
+
+
+SPECS = [(7,), (3, 5), (11,), (2, 2, 2), (13,), (4,)]
+
+
+def _make_params(prefix, specs=SPECS, dtype="float32", grad_reqs=None):
+    params = []
+    for k, shape in enumerate(specs):
+        req = grad_reqs[k] if grad_reqs else "write"
+        p = gluon.Parameter("%s%d" % (prefix, k), shape=shape, dtype=dtype,
+                            grad_req=req)
+        p.initialize(ctx=mx.cpu())
+        params.append(p)
+    return params
+
+
+def _seed(params, weights, grads):
+    for p, w, g in zip(params, weights, grads):
+        p.data()._write(jnp.asarray(w).astype(p.data().dtype))
+        if p.grad_req != "null":
+            p.grad()._write(jnp.asarray(g).astype(p.data().dtype))
+
+
+def _state_leaves(state):
+    if state is None:
+        return []
+    if isinstance(state, (tuple, list)):
+        out = []
+        for s in state:
+            out.extend(_state_leaves(s))
+        return out
+    return [state]
+
+
+def _assert_bit_identical(params_a, params_b, trainer_a, trainer_b):
+    for a, b in zip(params_a, params_b):
+        wa, wb = a.data().asnumpy(), b.data().asnumpy()
+        assert wa.dtype == wb.dtype
+        assert wa.tobytes() == wb.tobytes(), \
+            "weight %s diverged (max |d|=%g)" % (
+                a.name, float(np.max(np.abs(
+                    wa.astype(np.float64) - wb.astype(np.float64)))))
+    sa, sb = trainer_a._updaters[0].states, trainer_b._updaters[0].states
+    assert set(sa) == set(sb)
+    for i in sa:
+        for x, y in zip(_state_leaves(sa[i]), _state_leaves(sb[i])):
+            assert x.asnumpy().tobytes() == y.asnumpy().tobytes(), \
+                "state %d diverged" % i
+
+
+def _parity_run(optimizer, opt_kw, specs=SPECS, dtype="float32",
+                grad_reqs=None, bucket_bytes=40, steps=4, kvstore=None,
+                batch_size=2):
+    rs = np.random.RandomState(7)
+    weights = [rs.randn(*s).astype(np.float32) for s in specs]
+    grads = [rs.randn(*s).astype(np.float32) for s in specs]
+    pa = _make_params("a", specs, dtype, grad_reqs)
+    pb = _make_params("b", specs, dtype, grad_reqs)
+    _seed(pa, weights, grads)
+    _seed(pb, weights, grads)
+    make_kv = lambda: mx.kv.create(kvstore) if kvstore else None
+    ta = gluon.Trainer(pa, optimizer, dict(opt_kw), kvstore=make_kv())
+    tb = gluon.Trainer(pb, optimizer, dict(opt_kw), kvstore=make_kv())
+    ta._bucket_bytes_override = 0           # force the per-param path
+    tb._bucket_bytes_override = bucket_bytes
+    for _ in range(steps):
+        ta.step(batch_size)
+        tb.step(batch_size)
+    assert tb._fused_plan() is not None, \
+        "bucketed trainer unexpectedly fell back to per-param"
+    _assert_bit_identical(pa, pb, ta, tb)
+    return ta, tb
+
+
+def test_sgd_parity_with_frozen_and_null_holes():
+    # grad_req="null" holes must be skipped by both paths identically
+    _parity_run("sgd", {"learning_rate": 0.1, "wd": 0.01},
+                grad_reqs=["write", "null", "write", "write", "null",
+                           "write"])
+
+
+def test_sgd_momentum_parity_small_buckets():
+    # tiny bucket target -> several buckets with non-divisible tails
+    _parity_run("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.01},
+                bucket_bytes=48)
+
+
+def test_sgd_clip_gradient_parity():
+    _parity_run("sgd", {"learning_rate": 0.1, "momentum": 0.9,
+                        "clip_gradient": 0.5})
+
+
+def test_sgd_momentum_multi_precision_bf16_parity():
+    # f32 master weights + momentum; weight, master copy and momentum all
+    # bit-identical (states compared by _assert_bit_identical)
+    _parity_run("sgd", {"learning_rate": 0.05, "momentum": 0.9,
+                        "wd": 0.001, "multi_precision": True},
+                dtype="bfloat16", bucket_bytes=24, steps=6)
+
+
+def test_adam_parity():
+    _parity_run("adam", {"learning_rate": 0.01},
+                grad_reqs=["write", "null", "write", "write", "write",
+                           "write"], steps=5)
+
+
+def test_adam_parity_through_dist_sync_kvstore():
+    # single-worker dist_sync: update_on_kvstore=False, so the bucketed
+    # path rides the flat-reduce wire (reduce_many) end to end
+    _parity_run("adam", {"learning_rate": 0.01}, kvstore="dist_sync",
+                steps=3)
+
+
+def test_single_param_bucket_tail():
+    # one lonely param smaller than any target: a single ragged bucket
+    _parity_run("sgd", {"learning_rate": 0.1, "momentum": 0.9},
+                specs=[(5,)], bucket_bytes=1 << 20, steps=3)
+
+
+def test_lr_and_batch_size_changes_stay_bit_identical():
+    """Changing lr / batch_size mid-run keeps parity: the fused cache
+    keys on the scalars exactly as the per-param Operator.bind cache
+    does, so every combination compiles to matching constants."""
+    rs = np.random.RandomState(3)
+    weights = [rs.randn(*s).astype(np.float32) for s in SPECS]
+    grads = [rs.randn(*s).astype(np.float32) for s in SPECS]
+    pa = _make_params("lra", SPECS)
+    pb = _make_params("lrb", SPECS)
+    _seed(pa, weights, grads)
+    _seed(pb, weights, grads)
+    ta = gluon.Trainer(pa, "sgd", {"learning_rate": 0.1}, kvstore=None)
+    tb = gluon.Trainer(pb, "sgd", {"learning_rate": 0.1}, kvstore=None)
+    ta._bucket_bytes_override = 0
+    ta.step(2)
+    tb.step(2)
+    for lr, bs in [(0.05, 2), (0.01, 4), (0.2, 1), (0.05, 2)]:
+        ta.set_learning_rate(lr)
+        tb.set_learning_rate(lr)
+        ta.step(bs)
+        tb.step(bs)
+    _assert_bit_identical(pa, pb, ta, tb)
+
+
+def test_momentum_flip_mid_run_stays_bit_identical():
+    """Flipping momentum after states exist, then unfreezing a param:
+    the unfrozen param gets a momentum state while the others keep None.
+    The per-param formulas key off the state object, so the plan must
+    bucket by state arity (a mixed bucket would mix formulas) and stay
+    bit-identical to the per-param path."""
+    rs = np.random.RandomState(13)
+    weights = [rs.randn(*s).astype(np.float32) for s in SPECS]
+    grads = [rs.randn(*s).astype(np.float32) for s in SPECS]
+    reqs = ["write", "write", "null", "write", "write", "write"]
+    pa = _make_params("mfa", SPECS, grad_reqs=list(reqs))
+    pb = _make_params("mfb", SPECS, grad_reqs=list(reqs))
+    _seed(pa, weights, grads)
+    _seed(pb, weights, grads)
+    ta = gluon.Trainer(pa, "sgd", {"learning_rate": 0.1}, kvstore=None)
+    tb = gluon.Trainer(pb, "sgd", {"learning_rate": 0.1}, kvstore=None)
+    ta._bucket_bytes_override = 0
+    tb._bucket_bytes_override = 48
+    for _ in range(2):
+        ta.step(2)
+        tb.step(2)
+    # momentum flips on; pre-existing states stay momentum-free
+    ta._optimizer.momentum = tb._optimizer.momentum = 0.9
+    # the frozen param thaws: its state is created under momentum=0.9
+    pa[2].grad_req = pb[2].grad_req = "write"
+    pa[2].grad()._write(jnp.asarray(grads[2]))
+    pb[2].grad()._write(jnp.asarray(grads[2]))
+    for _ in range(3):
+        ta.step(2)
+        tb.step(2)
+    plan = tb._fused_plan()
+    assert plan is not None
+    arities = {len(opt_leaves) for opt_leaves in (
+        [_state_leaves(tb._updaters[0].states[i]) for b in plan[0]
+         for i in b.indices])}
+    assert arities == {0, 1}        # both variants exist, in separate buckets
+    _assert_bit_identical(pa, pb, ta, tb)
+
+
+def test_fused_fallbacks():
+    """Configurations outside the fused contract must yield plan None."""
+    rs = np.random.RandomState(5)
+    weights = [rs.randn(*s).astype(np.float32) for s in SPECS]
+    grads = [rs.randn(*s).astype(np.float32) for s in SPECS]
+
+    # unsupported optimizer class (RMSProp has no fused kernel)
+    p = _make_params("fb1", SPECS)
+    _seed(p, weights, grads)
+    t = gluon.Trainer(p, "rmsprop", {"learning_rate": 0.01}, kvstore=None)
+    t.step(2)
+    assert t._fused_plan() is None
+
+    # bucketing disabled by GRAFT_BUCKET_BYTES<=0
+    p = _make_params("fb2", SPECS)
+    _seed(p, weights, grads)
+    t = gluon.Trainer(p, "sgd", {"learning_rate": 0.01}, kvstore=None)
+    t._bucket_bytes_override = 0
+    t.step(2)
+    assert t._fused_plan() is None
+
+    # update_on_kvstore (explicit local store instance) falls back
+    p = _make_params("fb3", SPECS)
+    _seed(p, weights, grads)
+    t = gluon.Trainer(p, "sgd", {"learning_rate": 0.01},
+                      kvstore=mx.kv.create("local"))
+    t.step(2)
+    assert t._update_on_kvstore and t._fused_plan() is None
+
+    # gradient compression keeps per-key residual state: per-param path
+    p = _make_params("fb4", SPECS)
+    _seed(p, weights, grads)
+    t = gluon.Trainer(p, "sgd", {"learning_rate": 0.01},
+                      kvstore=mx.kv.create("dist_sync"),
+                      compression_params={"type": "2bit"})
+    t.step(2)
+    assert t._fused_plan() is None
+
+
+def test_trainer_save_load_states_roundtrip_on_fused_path():
+    """States created by the fused path serialize/load like per-param
+    ones (they live in the same Updater store)."""
+    rs = np.random.RandomState(11)
+    weights = [rs.randn(*s).astype(np.float32) for s in SPECS]
+    grads = [rs.randn(*s).astype(np.float32) for s in SPECS]
+    p = _make_params("sl", SPECS)
+    _seed(p, weights, grads)
+    t = gluon.Trainer(p, "sgd", {"learning_rate": 0.1, "momentum": 0.9},
+                      kvstore=None)
+    t.step(2)
+    t.save_states("/tmp/fused_trainer.states")
+    before = {i: s.asnumpy().copy()
+              for i, s in t._updaters[0].states.items()}
+    t.load_states("/tmp/fused_trainer.states")
+    t.step(2)                     # fused path must survive a state reload
+    for i, s in t._updaters[0].states.items():
+        assert not np.array_equal(s.asnumpy(), before[i]) or \
+            np.all(before[i] == 0)
+
+
+# ---------------------------------------------------------------------------
+# kvstore multi-key batching parity
+# ---------------------------------------------------------------------------
+
+def test_kvstore_push_pull_many_matches_per_key():
+    rs = np.random.RandomState(2)
+    shapes = [(4, 3), (5,), (2, 2)]
+    vals = [rs.randn(*s).astype(np.float32) for s in shapes]
+    upd = [rs.randn(*s).astype(np.float32) for s in shapes]
+
+    kv_a = mx.kv.create("local")
+    kv_b = mx.kv.create("local")
+    keys = list(range(len(shapes)))
+    kv_a.init(keys, [mx.nd.array(v) for v in vals])
+    kv_b.init(keys, [mx.nd.array(v) for v in vals])
+
+    # per-key push/pull
+    for k in keys:
+        kv_a.push(k, mx.nd.array(upd[k]))
+    outs_a = [mx.nd.array(np.zeros(s, np.float32)) for s in shapes]
+    for k in keys:
+        kv_a.pull(k, outs_a[k])
+
+    # batched multi-key push/pull
+    kv_b.push_many(keys, [mx.nd.array(u) for u in upd])
+    outs_b = [mx.nd.array(np.zeros(s, np.float32)) for s in shapes]
+    kv_b.pull_many(keys, outs_b)
+
+    for a, b in zip(outs_a, outs_b):
+        assert a.asnumpy().tobytes() == b.asnumpy().tobytes()
+
+
+def test_kvstore_pull_mixed_dtype_out_still_casts():
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.array(np.ones((3,), np.float32) * 1.5))
+    out16 = mx.nd.array(np.zeros((3,), np.float16), dtype=np.float16)
+    kv.pull(0, out16)
+    assert out16.asnumpy().dtype == np.float16
+    np.testing.assert_allclose(out16.asnumpy().astype(np.float32),
+                               [1.5, 1.5, 1.5])
+
+
+def test_kvstore_reduce_many_single_worker_identity():
+    vals = [mx.nd.array(np.arange(4, dtype=np.float32)),
+            mx.nd.array(np.ones((2, 2), np.float32))]
+    kv = mx.kv.create("local")
+    before = [v.asnumpy().copy() for v in vals]
+    kv.reduce_many(vals)
+    for v, b in zip(vals, before):
+        assert np.array_equal(v.asnumpy(), b)
+
+
+# ---------------------------------------------------------------------------
+# bounded engine caches (GRAFT_REPLAY_CACHE_SIZE)
+# ---------------------------------------------------------------------------
+
+def test_replay_cache_size_bounded(monkeypatch):
+    monkeypatch.setenv("GRAFT_REPLAY_CACHE_SIZE", "3")
+    engine._replay_cache.clear()
+    rs = np.random.RandomState(0)
+    a = mx.nd.array(rs.rand(3, 3))
+    # 6 distinct segment shapes -> 6 distinct cache keys, bound is 3
+    for n in range(1, 7):
+        with engine.bulk(64):
+            x = a
+            for _ in range(n):
+                x = x + 1.0
+            x.asnumpy()
+    assert len(engine._replay_cache) <= 3
+
+
+def test_replay_cache_lru_keeps_hot_entry(monkeypatch):
+    monkeypatch.setenv("GRAFT_REPLAY_CACHE_SIZE", "2")
+    cache = engine.BoundedCache()
+    cache["a"] = 1
+    cache["b"] = 2
+    assert cache.get("a") == 1          # refresh "a"
+    cache["c"] = 3                      # evicts "b", not "a"
+    assert "a" in cache and "c" in cache and "b" not in cache
+    assert len(cache) == 2
+
+
+def test_replay_cache_gauge_exposed():
+    from incubator_mxnet_tpu import telemetry
+    with engine.bulk(8):
+        (mx.nd.ones((2, 2)) + 1.0).asnumpy()
+    snap = telemetry.compact_snapshot()
+    key = 'graft_engine_replay_cache_size{cache="replay"}'
+    assert key in snap and snap[key] >= 1
+    assert 'graft_engine_replay_cache_size{cache="fused_update"}' in snap
+
+
+def test_trainer_bucket_metrics_emitted():
+    from incubator_mxnet_tpu import telemetry
+    rs = np.random.RandomState(9)
+    weights = [rs.randn(*s).astype(np.float32) for s in SPECS]
+    grads = [rs.randn(*s).astype(np.float32) for s in SPECS]
+    p = _make_params("tm", SPECS)
+    _seed(p, weights, grads)
+    t = gluon.Trainer(p, "sgd", {"learning_rate": 0.1}, kvstore=None)
+    t._bucket_bytes_override = 64
+    t.step(2)
+    snap = telemetry.compact_snapshot()
+    assert snap.get("graft_trainer_bucket_count", 0) >= 1
+    assert snap.get("graft_trainer_bucket_fused_updates_total", 0) >= 1
+    assert snap.get("graft_trainer_bucket_bytes_count", 0) >= 1

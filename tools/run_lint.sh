@@ -9,6 +9,9 @@
 # 2. telemetry smoke — dump a chrome trace from a 3-op bulked program and
 #    validate the schema + record→flush flow links (graftscope); a trace
 #    regression exits non-zero just like a lint finding.
+# 3. graftfuse smoke — bench_eager.py --smoke steps a many-small-param
+#    Trainer through the bucketed fused path and asserts bit-parity with
+#    the per-param path, so a fused-step regression fails this tier.
 #
 # Usage: tools/run_lint.sh [report.json]
 set -uo pipefail
@@ -16,5 +19,7 @@ cd "$(dirname "$0")/.."
 
 REPORT="${1:-/tmp/graftlint_report.json}"
 python -m incubator_mxnet_tpu.analysis.graftlint --all --report "$REPORT" \
+    || exit $?
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_eager.py --smoke \
     || exit $?
 exec python -m incubator_mxnet_tpu.telemetry --selftest
