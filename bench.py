@@ -187,6 +187,7 @@ def main():
             "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
             "host_pipeline_img_per_sec": round(pipe_img_s, 2),
             "metrics": mx.telemetry.compact_snapshot(),
+            "blackbox": mx.telemetry.blackbox.stats(),
         }))
         return
     else:
@@ -214,6 +215,7 @@ def main():
         "backend": backend,
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
         "metrics": mx.telemetry.compact_snapshot(),
+        "blackbox": mx.telemetry.blackbox.stats(),
     }))
 
 

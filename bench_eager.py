@@ -91,11 +91,55 @@ def _fused_step_bench(iters=30, n_params=FUSED_N_PARAMS, shape=FUSED_SHAPE):
     }
 
 
+def _blackbox_overhead_bench(iters=ITERS, repeats=5):
+    """Flight-recorder steady-state cost on the 64-op bulked dispatch
+    chain: the same loop timed with the recorder ON (the default) vs
+    forced OFF, interleaved across ``repeats`` rounds (min-of-rounds on
+    both sides cancels machine drift).  The acceptance bar is < 2%
+    (ISSUE 6): the recorder's per-flush cost is one ring append + one
+    in-flight bracket, amortized over a whole segment dispatch."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.telemetry import blackbox
+
+    rs = np.random.RandomState(1)
+    a = mx.nd.array(rs.rand(*SHAPE).astype(np.float32))
+    b = mx.nd.array(rs.rand(*SHAPE).astype(np.float32) + 0.5)
+    c = mx.nd.array(rs.rand(*SHAPE).astype(np.float32))
+    with mx.engine.bulk(CHAIN + 1):
+        _chain_eager(a, b, c, CHAIN).asnumpy()      # compile the replay
+
+    def timed():
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            with mx.engine.bulk(CHAIN + 1):
+                out = _chain_eager(a, b, c, CHAIN)
+        out.asnumpy()
+        return time.perf_counter() - t0
+
+    best = {True: float("inf"), False: float("inf")}
+    prev = blackbox._enabled_override
+    try:
+        for _ in range(repeats):
+            for state in (False, True):
+                blackbox.set_enabled(state)
+                timed()                              # warm this mode
+                best[state] = min(best[state], timed())
+    finally:
+        blackbox.set_enabled(prev)
+    pct = (best[True] - best[False]) / best[False] * 100.0
+    return {
+        "blackbox_on_ops_per_sec": round(CHAIN * iters / best[True], 1),
+        "blackbox_off_ops_per_sec": round(CHAIN * iters / best[False], 1),
+        "blackbox_overhead_pct": round(pct, 2),
+    }
+
+
 def smoke():
     """Fast path for the lint tier: exercise the bucketed step +
     bit-parity assert in a few seconds, print one JSON line."""
     import jax
     res = _fused_step_bench(iters=3)
+    res.update(_blackbox_overhead_bench(iters=10, repeats=3))
     res["metric"] = "fused_step_smoke"
     res["backend"] = jax.default_backend()
     print(json.dumps(res))
@@ -241,8 +285,12 @@ def main():
     # -- graftfuse: bucketed Trainer.step vs per-param (round 4) ---------
     fused = _fused_step_bench(iters=ITERS)
 
+    # -- graftwatch: flight-recorder overhead on the same 64-op chain ----
+    blackbox_overhead = _blackbox_overhead_bench()
+
     print(json.dumps({
         **fused,
+        **blackbox_overhead,
         "metric": "eager_small_op_dispatch",
         "backend": backend,
         "chain_len": CHAIN,
@@ -272,6 +320,8 @@ def main():
         # graftscope: the registry snapshot rides along so the perf
         # trajectory carries flush/segment/phase counters per round
         "metrics": mx.telemetry.compact_snapshot(),
+        # graftwatch: recorder status (ring occupancy + event mix)
+        "blackbox": mx.telemetry.blackbox.stats(),
     }))
 
 

@@ -80,6 +80,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 import weakref
 
 import numpy as np
@@ -89,6 +90,7 @@ import jax.numpy as jnp
 from .analysis.engine_check import (EngineHazardError,
                                     check_segment_integrity, oracle_compare)
 from . import profiler as _profiler
+from .telemetry import blackbox as _blackbox
 from .telemetry import metrics as _tmetrics
 from .telemetry import tracing as _ttracing
 
@@ -668,6 +670,7 @@ def flush(state=None, cause="read"):
     st.flow_marks = []
     st.epoch += 1
 
+    err = None
     if st.check:
         # EH103 — validate operand references AFTER the state reset, so a
         # hazard raised here leaves the scope reusable (the scope-close
@@ -679,7 +682,7 @@ def flush(state=None, cause="read"):
         except EngineHazardError as exc:
             for p in pendings:
                 p.error = exc
-            raise
+            err = exc
 
     # only values still EXPOSED through a live NDArray leave the program:
     # the owner must not just be alive, its buffer must still be this
@@ -702,34 +705,53 @@ def flush(state=None, cause="read"):
            tuple((tuple(v.shape), str(v.dtype)) for v in ext),
            live)
     prof_on = _profiler._P.active()
+    bb_on = _blackbox.enabled()
     span_begin = _profiler._now_us() if prof_on else 0.0
-    entry = _replay_cache.get(key)
-    cache_hit = entry is not None
-    if entry is None:
-        replay = _build_replay(instrs, live)
-        entry = (jax.jit(replay), replay)
-        _replay_cache[key] = entry
-    fn, replay = entry
-    try:
-        results = fn(ext)
-        if st.check and results:
-            # EH104 — the fusion-equivalence oracle: replay the segment
-            # UNFUSED (the same replay closure outside jit dispatches each
-            # op eagerly) and bit-compare every live output.  Costs a full
-            # second execution per flush; debug-only by construction.
-            oracle_compare(results, replay(ext), instrs, live)
-    except Exception as exc:
-        # stamp every pending with the real cause: later reads raise THIS
-        # instead of a misleading liveness error
-        for p in pendings:
-            p.error = exc
-        raise
+    t0 = time.perf_counter() if bb_on else 0.0
+    results = None
+    cache_hit = False
+    if err is None:
+        entry = _replay_cache.get(key)
+        cache_hit = entry is not None
+        if entry is None:
+            replay = _build_replay(instrs, live)
+            entry = (jax.jit(replay), replay)
+            _replay_cache[key] = entry
+        fn, replay = entry
+        try:
+            # graftwatch bracket: a stalled dispatch shows up in-flight
+            # (the watchdog names this segment when it trips)
+            with _blackbox.in_flight("engine_flush",
+                                     {"segment": seg_id, "cause": cause,
+                                      "nodes": len(instrs)}):
+                results = fn(ext)
+                if st.check and results:
+                    # EH104 — the fusion-equivalence oracle: replay the
+                    # segment UNFUSED (the same replay closure outside jit
+                    # dispatches each op eagerly) and bit-compare every
+                    # live output.  Costs a full second execution per
+                    # flush; debug-only by construction.
+                    oracle_compare(results, replay(ext), instrs, live)
+        except Exception as exc:
+            # stamp every pending with the real cause: later reads raise
+            # THIS instead of a misleading liveness error
+            for p in pendings:
+                p.error = exc
+            err = exc
+    if bb_on:
+        fields = {"segment": seg_id, "cause": cause, "nodes": len(instrs),
+                  "live_outputs": len(live),
+                  "cache": "hit" if cache_hit else "miss",
+                  "latency_ms": round((time.perf_counter() - t0) * 1e3, 3)}
+        if err is not None:
+            fields["error"] = repr(err)
+        _blackbox.record("engine_flush", **fields)
     if prof_on or flow_marks:
         # the segment span is where op cost actually lands: with
         # profiler.sync the dispatch blocks until ready, so the span IS
         # device latency (the flush-level analogue of sync-mode op spans).
         # A segment whose records emitted flow starts ALWAYS closes its
-        # links here, even if the profiler was stopped mid-segment —
+        # links here — profiler stopped mid-segment OR replay raised —
         # a dangling arrow would fail the trace validator
         device_time = _profiler.want_sync()
         if device_time and results:
@@ -738,7 +760,9 @@ def flush(state=None, cause="read"):
         _ttracing.segment_flush_span(
             seg_id, cause, begin, _profiler._now_us(),
             flow_marks, len(instrs), len(live), cache_hit,
-            recorded, device_time)
+            recorded, device_time, error=err is not None)
+    if err is not None:
+        raise err
     for i, v in zip(live, results):
         pendings[i].value = v
     if recorded:

@@ -163,17 +163,23 @@ class Trainer(object):
         if not self._kv_initialized:
             self._init_kvstore()
         plan = None if ignore_stale_grad else self._fused_plan()
+        from ..telemetry import blackbox as _blackbox
         from ..telemetry import tracing as _ttracing
-        with _ttracing.phase_span("kvstore"):
-            if plan is None:
-                self._allreduce_grads()
-            else:
-                reduced = self._bucketed_allreduce(plan)
-        with _ttracing.phase_span("update"):
-            if plan is None:
-                self._update(ignore_stale_grad)
-            else:
-                self._bucketed_update(plan, reduced)
+        # graftwatch step journal: one flight-recorder event per step
+        # with kvstore/update phase latencies + device-memory highwater;
+        # a crash or hang mid-step names the phase it stopped in
+        with _blackbox.step_journal("trainer", batch_size=batch_size,
+                                    fused=plan is not None):
+            with _ttracing.phase_span("kvstore"):
+                if plan is None:
+                    self._allreduce_grads()
+                else:
+                    reduced = self._bucketed_allreduce(plan)
+            with _ttracing.phase_span("update"):
+                if plan is None:
+                    self._update(ignore_stale_grad)
+                else:
+                    self._bucketed_update(plan, reduced)
 
     def allreduce_grads(self):
         """ref: trainer.py allreduce_grads (1.3+, for grad accumulation)."""

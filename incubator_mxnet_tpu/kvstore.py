@@ -29,6 +29,7 @@ from .base import MXNetError
 from .ndarray import NDArray
 from .ndarray import ndarray as _nd
 from . import optimizer as opt
+from .telemetry import blackbox as _blackbox
 from .telemetry import metrics as _tmetrics
 
 
@@ -133,8 +134,13 @@ class KVStore(object):
         _tmetrics.kvstore_push(raw_bytes, wire_bytes)
         # one fused cross-worker collective for the whole push
         # (ref: big-array sharding amortization, kvstore_dist.h — here the
-        # amortization is batching keys into a single allreduce)
-        self._cross_worker_reduce_many([r for _, r in entries])
+        # amortization is batching keys into a single allreduce); the
+        # graftwatch bracket records it in the flight recorder and puts a
+        # stalled allreduce in the watchdog's sights
+        with _blackbox.collective("push", n_keys=len(entries),
+                                  keys=[k for k, _ in entries[:4]],
+                                  nbytes=raw_bytes, wire_bytes=wire_bytes):
+            self._cross_worker_reduce_many([r for _, r in entries])
         for k, red in entries:
             if self._updater is not None:
                 self._updater(_int_key(k), red, self._store[k])
@@ -175,26 +181,32 @@ class KVStore(object):
         raw = sum(_nd_bytes(v) for v in values)
         _tmetrics.kvstore_push(raw, raw)
         _tmetrics.kvstore_pull(raw)
-        return self._cross_worker_reduce_many(list(values))
+        with _blackbox.collective("reduce_many", n_keys=len(values),
+                                  nbytes=raw):
+            return self._cross_worker_reduce_many(list(values))
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast store value into out list (ref: KVStore::Pull)."""
         assert out is not None
         keys, outs = self._normalize(key, out)
-        pulled = 0
-        for k, olist in zip(keys, outs):
-            if k not in self._store:
-                raise MXNetError("key %s has not been initialized" % k)
-            # hoist the store read out of the replica loop, and skip the
-            # astype copy when dtypes already match — the common Trainer
-            # pull (grad -> grad, same dtype) is then a pure rebind
-            val = self._store[k]._read()
-            src_dtype = np.dtype(val.dtype)
-            for o in olist:
-                o._write(val if np.dtype(o.dtype) == src_dtype
-                         else val.astype(o.dtype))
-                pulled += _nd_bytes(o)
-        _tmetrics.kvstore_pull(pulled)
+        # one metadata pass sizes the payload for both the flight
+        # recorder and the byte counter (every write below either lands
+        # or raises, so the up-front sum IS the pulled total)
+        nbytes = sum(_nd_bytes(o) for olist in outs for o in olist)
+        with _blackbox.collective("pull", n_keys=len(keys), keys=keys[:4],
+                                  nbytes=nbytes):
+            for k, olist in zip(keys, outs):
+                if k not in self._store:
+                    raise MXNetError("key %s has not been initialized" % k)
+                # hoist the store read out of the replica loop, and skip
+                # the astype copy when dtypes already match — the common
+                # Trainer pull (grad -> grad, same dtype) is a pure rebind
+                val = self._store[k]._read()
+                src_dtype = np.dtype(val.dtype)
+                for o in olist:
+                    o._write(val if np.dtype(o.dtype) == src_dtype
+                             else val.astype(o.dtype))
+        _tmetrics.kvstore_pull(nbytes)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only selected rows (ref: KVStore::PullRowSparse,

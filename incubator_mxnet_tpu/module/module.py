@@ -372,31 +372,38 @@ class Module(BaseModule):
         training-loop hooks)."""
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
+        from ..telemetry import blackbox as _blackbox
         from ..telemetry import tracing as _ttracing
         self._params_dirty = True
-        if self._update_on_kvstore:
-            with _ttracing.phase_span("kvstore"):
+        # graftwatch step journal: Module's optimizer step lands as one
+        # flight-recorder event with its phase latencies (the fwd/bwd
+        # phases of forward_backward record as standalone phase events)
+        with _blackbox.step_journal("module",
+                                    on_kvstore=self._update_on_kvstore):
+            if self._update_on_kvstore:
+                with _ttracing.phase_span("kvstore"):
+                    for idx, name in enumerate(self._param_names):
+                        grads = self._exec_group.grad_arrays[idx]
+                        self._kvstore.push(idx, grads, priority=-idx)
+                        self._kvstore.pull(
+                            idx, self._exec_group.param_arrays[idx],
+                            priority=-idx)
+                return
+            if self._kvstore:
+                with _ttracing.phase_span("kvstore"):
+                    for idx, name in enumerate(self._param_names):
+                        grads = self._exec_group.grad_arrays[idx]
+                        self._kvstore.push(idx, grads, priority=-idx)
+                        self._kvstore.pull(idx, grads, priority=-idx)
+            with _ttracing.phase_span("update"):
                 for idx, name in enumerate(self._param_names):
-                    grads = self._exec_group.grad_arrays[idx]
-                    self._kvstore.push(idx, grads, priority=-idx)
-                    self._kvstore.pull(
-                        idx, self._exec_group.param_arrays[idx],
-                        priority=-idx)
-            return
-        if self._kvstore:
-            with _ttracing.phase_span("kvstore"):
-                for idx, name in enumerate(self._param_names):
-                    grads = self._exec_group.grad_arrays[idx]
-                    self._kvstore.push(idx, grads, priority=-idx)
-                    self._kvstore.pull(idx, grads, priority=-idx)
-        with _ttracing.phase_span("update"):
-            for idx, name in enumerate(self._param_names):
-                for dev_i, (w, g) in enumerate(zip(
-                        self._exec_group.param_arrays[idx],
-                        self._exec_group.grad_arrays[idx])):
-                    if g is None:
-                        continue
-                    self._updater(idx * len(self._context) + dev_i, g, w)
+                    for dev_i, (w, g) in enumerate(zip(
+                            self._exec_group.param_arrays[idx],
+                            self._exec_group.grad_arrays[idx])):
+                        if g is None:
+                            continue
+                        self._updater(idx * len(self._context) + dev_i,
+                                      g, w)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

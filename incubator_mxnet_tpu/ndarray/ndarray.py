@@ -731,18 +731,25 @@ def invoke(op: Operator, inputs, params, out=None):
                               args={"device_time": _profiler.want_sync()})
     if _span is not None:
         _span.__enter__()
-    if recording:
-        fn = op.bind(params, is_train)
-        if kw:
-            rng = kw["rng"]
-            wrapped = lambda *xs: fn(*xs, rng=rng)
+    try:
+        if recording:
+            fn = op.bind(params, is_train)
+            if kw:
+                rng = kw["rng"]
+                wrapped = lambda *xs: fn(*xs, rng=rng)
+            else:
+                wrapped = fn
+            out_vals, vjp_fn = jax.vjp(wrapped, *vals)
         else:
-            wrapped = fn
-        out_vals, vjp_fn = jax.vjp(wrapped, *vals)
-    else:
-        fn = op.bind(params, is_train)
-        out_vals = fn(*vals, **kw)
-        vjp_fn = None
+            fn = op.bind(params, is_train)
+            out_vals = fn(*vals, **kw)
+            vjp_fn = None
+    except Exception as exc:
+        # close the span on the exception path too: a crash-time trace
+        # must not lose the op that raised (graftwatch satellite)
+        if _span is not None:
+            _span.__exit__(type(exc), exc, None)
+        raise
     if _span is not None:
         if _profiler.want_sync():
             jax.block_until_ready(out_vals)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 
 import numpy as np
 import jax
@@ -26,6 +27,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..kvstore import KVStore
+from ..telemetry import blackbox as _blackbox
+from ..telemetry import metrics as _tmetrics
 from . import compression
 
 __all__ = ["init_process", "rank", "num_workers", "barrier", "DistKVStore"]
@@ -137,6 +140,8 @@ class DistKVStore(KVStore):
     def __init__(self, type_):
         super().__init__(type_)
         init_process()
+        _blackbox.set_rank(rank())      # stamp dumps with this worker
+        self._hb_step = 0               # dist heartbeat step counter
         self._ps_server = None
         self._ps = None
         if type_ == "dist_async":
@@ -194,7 +199,9 @@ class DistKVStore(KVStore):
                 red = self._compressor.compress(k, red)
             batch[str(k)] = self._async_np(red)
         _tmetrics.kvstore_push(raw_bytes, wire_bytes)
-        self._ps.push(batch)    # applied immediately server-side; returns
+        with _blackbox.collective("ps_push", n_keys=len(batch),
+                                  nbytes=raw_bytes):
+            self._ps.push(batch)    # applied immediately server-side
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if self._ps is None:
@@ -205,7 +212,8 @@ class DistKVStore(KVStore):
         from ..telemetry import metrics as _tmetrics
         assert out is not None
         keys, outs = self._normalize(key, out)
-        fetched = self._ps.pull([str(k) for k in keys])
+        with _blackbox.collective("ps_pull", n_keys=len(keys)):
+            fetched = self._ps.pull([str(k) for k in keys])
         pulled = 0
         for k, olist in zip(keys, outs):
             v = fetched[str(k)]
@@ -260,10 +268,19 @@ class DistKVStore(KVStore):
         kvstore_dist.h:109-115).  Only the async parameter service keeps
         heartbeats; on the sync wire the jax coordination service
         terminates the job on member failure, so a live process always
-        observes 0."""
+        observes 0.  Either way the answer is SURFACED, not just
+        returned: the ``graft_dist_dead_nodes`` gauge tracks it and a
+        nonzero count lands in the flight recorder (graftwatch — a
+        silent return left post-mortems blind to the lost worker)."""
         if self._ps is None:
-            return 0
-        return len(self._ps.dead_nodes(window=float(timeout_sec)))
+            dead = []
+        else:
+            dead = list(self._ps.dead_nodes(window=float(timeout_sec)))
+        _tmetrics.dist_dead_nodes(len(dead))
+        if dead:
+            _blackbox.record("dead_nodes", dead=dead,
+                             window_s=float(timeout_sec), rank=rank())
+        return len(dead)
 
     def _sync_init(self, key, value):
         """Rank 0's value defines the key globally (ref: kvstore_dist.h
@@ -412,7 +429,41 @@ class DistKVStore(KVStore):
             pieces = _engine.split_flat(summed, [v.shape for v in vals])
             for r, piece in zip(group, pieces):
                 r._write(piece)
+        # graftwatch straggler detection piggybacks on this sync path:
+        # one tiny extra allreduce per reduce BATCH (not per key) carries
+        # every worker's arrival timestamp + step counter.  Gated on the
+        # recorder switch, which therefore must be set CONSISTENTLY
+        # across ranks (collective-lockstep contract) — see docs.
+        if _blackbox.enabled():
+            self._heartbeat_skew()
         return reds
+
+    def _heartbeat_skew(self):
+        """Per-worker step heartbeat: each rank contributes its arrival
+        time (ms, int32 — jax x64 is off and float32 cannot hold epoch
+        milliseconds) and step count in its own slot of a (2W,) vector;
+        the allreduce sum hands every rank the full table.  Feeds the
+        per-step worker-skew histogram, the flight recorder's last-seen
+        table, and a straggler log line when the skew is extreme."""
+        W = num_workers()
+        self._hb_step += 1
+        now_ms = int(time.time() * 1000) % (1 << 31)
+        vec = np.zeros((2 * W,), np.int32)
+        vec[rank()] = now_ms
+        vec[W + rank()] = self._hb_step % (1 << 31)
+        out = np.asarray(_global_sum(jnp.asarray(vec))).astype(np.int64)
+        ts_ms, steps = out[:W], out[W:]
+        # mod-wrap unwrap: a rank that crossed the 2^31 ms boundary while
+        # others have not would otherwise read as ~24 days of skew
+        if ts_ms.max() - ts_ms.min() > (1 << 30):
+            ts_ms = np.where(ts_ms < (1 << 30), ts_ms + (1 << 31), ts_ms)
+        skew = float(ts_ms.max() - ts_ms.min()) / 1e3
+        _tmetrics.dist_worker_skew(skew)
+        base = max(int(ts_ms.max()), now_ms)
+        _blackbox.workers_seen(
+            {r: {"lag_s": round(float(base - ts_ms[r]) / 1e3, 6),
+                 "step": int(steps[r])} for r in range(W)},
+            skew=skew, step=self._hb_step)
 
     def _sync_set_optimizer(self, optimizer):
         """dist_sync path: pickle round-trip, as the reference ships the

@@ -154,6 +154,10 @@ class _OpSpan:
         return self
 
     def __exit__(self, *exc):
+        # the span closes on the exception path too (marked, so a trace
+        # of a crashing op is well-formed AND says the op failed)
+        if exc and exc[0] is not None:
+            self.args = dict(self.args or {}, error=True)
         record_event(self.name, self.begin, _now_us(), args=self.args)
         return False
 

@@ -1,7 +1,6 @@
-"""graftscope — segment-aware tracing + unified metrics for the deferred
-engine.
+"""graftscope + graftwatch — observability for the deferred engine.
 
-Two halves (see docs/observability.md for the full guide):
+Four quarters (see docs/observability.md for the full guide):
 
 * :mod:`~incubator_mxnet_tpu.telemetry.tracing` — chrome-trace spans per
   bulk-segment flush with flow links from each deferred op's record
@@ -10,16 +9,28 @@ Two halves (see docs/observability.md for the full guide):
 * :mod:`~incubator_mxnet_tpu.telemetry.metrics` — the process-wide
   Counter/Gauge/Histogram registry (engine flush causes, kvstore bytes
   and compression ratio, io batches/sec, autograd tape sizes, device
-  memory, training phase latencies) with JSON snapshot and Prometheus
-  text expositions.
+  memory, training phase latencies, watchdog/dist liveness) with JSON
+  snapshot and Prometheus text expositions.
+* :mod:`~incubator_mxnet_tpu.telemetry.blackbox` — the always-on flight
+  recorder: a bounded ring of structured events (engine flushes,
+  kvstore collectives, step boundaries, dist heartbeats) dumped to JSON
+  on unhandled exception, SIGTERM/SIGINT, ``blackbox.dump()`` or a
+  watchdog trip.  Independent of ``GRAFT_TELEMETRY`` and the profiler.
+* :mod:`~incubator_mxnet_tpu.telemetry.watchdog` — the hang watchdog: a
+  thread that trips when an engine flush / dist collective / phase stays
+  in flight past ``GRAFT_WATCHDOG_TIMEOUT``, writing the dump + thread
+  stacks (and aborting under ``GRAFT_WATCHDOG_ABORT``).
 
 CLI::
 
     python -m incubator_mxnet_tpu.telemetry --summary [--json]
+    python -m incubator_mxnet_tpu.telemetry --blackbox PATH [--json]
 
 Environment: ``GRAFT_TELEMETRY=0`` disables metric collection;
 ``GRAFT_TELEMETRY_SNAPSHOT=<path>`` writes the JSON snapshot at process
-exit; ``GRAFT_TELEMETRY_TOPK`` sets the CLI's segment table size.
+exit; ``GRAFT_TELEMETRY_TOPK`` sets the CLI's segment table size;
+``GRAFT_BLACKBOX[_SIZE|_PATH]`` control the flight recorder;
+``GRAFT_WATCHDOG_TIMEOUT``/``GRAFT_WATCHDOG_ABORT`` the watchdog.
 """
 from __future__ import annotations
 
@@ -27,18 +38,29 @@ import os as _os
 
 from . import metrics
 from . import tracing
+from . import blackbox
+from . import watchdog
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       compact_snapshot, enabled, parse_prometheus_text,
                       registry, set_enabled, write_snapshot)
 from .tracing import phase_span
 
-__all__ = ["metrics", "tracing", "Counter", "Gauge", "Histogram",
-           "MetricsRegistry", "registry", "enabled", "set_enabled",
-           "parse_prometheus_text", "compact_snapshot", "write_snapshot",
-           "phase_span"]
+__all__ = ["metrics", "tracing", "blackbox", "watchdog", "Counter",
+           "Gauge", "Histogram", "MetricsRegistry", "registry", "enabled",
+           "set_enabled", "parse_prometheus_text", "compact_snapshot",
+           "write_snapshot", "phase_span"]
 
 _snapshot_path = _os.environ.get("GRAFT_TELEMETRY_SNAPSHOT")
 if _snapshot_path:
     import atexit as _atexit
 
     _atexit.register(lambda: write_snapshot(_snapshot_path))
+
+# graftwatch is ALWAYS-ON by default: the crash hooks (excepthook +
+# SIGTERM/SIGINT chains) install unconditionally — they re-check
+# enabled() at fire time and only write a dump when the recorder holds
+# events, so a process that starts with GRAFT_BLACKBOX=0 and calls
+# blackbox.set_enabled(True) later still gets its post-mortem.  The
+# watchdog thread only starts when GRAFT_WATCHDOG_TIMEOUT asks for it.
+blackbox.install_hooks()
+watchdog.maybe_start()

@@ -1,4 +1,4 @@
-"""graftscope CLI.
+"""graftscope + graftwatch CLI.
 
     python -m incubator_mxnet_tpu.telemetry --summary [--json]
         Run one bulked training step (gluon Trainer on CPU, a kvstore
@@ -10,10 +10,22 @@
         Same report over an existing chrome-trace dump (segment table
         from the file; the metrics section reflects this process).
 
+    python -m incubator_mxnet_tpu.telemetry --blackbox PATH [--json]
+        Post-mortem: reconstruct the final timeline from a flight-
+        recorder dump — reason, what was in flight (stuck segment /
+        collective / phase), the last engine flushes, step journal with
+        phase latencies, per-worker last-seen, watchdog verdict.
+        Exits 1 when the dump fails schema validation.
+
     python -m incubator_mxnet_tpu.telemetry --selftest
         Lint smoke tier: bulk a 3-op program, dump a trace, validate the
         chrome-trace schema + non-empty flow links.  Exit 1 on any
         regression.
+
+    python -m incubator_mxnet_tpu.telemetry --blackbox --selftest
+        Lint smoke tier for the flight recorder: exercise the full
+        pipeline (flushes, collectives, a step journal, an in-flight
+        bracket) and validate the dump schema.
 
 ``GRAFT_TELEMETRY_TOPK`` (default 10) sizes the segment table.
 """
@@ -164,10 +176,138 @@ def selftest():
     return problems
 
 
+def _render_blackbox_text(report):
+    """Human rendering of summarize_dump(): the final-timeline view."""
+    import datetime
+
+    def when(ts):
+        try:
+            return datetime.datetime.fromtimestamp(ts).isoformat(
+                timespec="milliseconds")
+        except (OverflowError, OSError, ValueError, TypeError):
+            return str(ts)
+
+    lines = ["graftwatch post-mortem", "=" * 60]
+    lines.append("reason: %-12s pid: %-8s rank: %s"
+                 % (report["reason"], report["pid"], report["rank"]))
+    lines.append("dumped at: %s" % when(report["dumped_at"]))
+    lp = report.get("last_progress") or {}
+    lines.append("last progress: %.3fs before dump (%s)"
+                 % (lp.get("age", 0.0), lp.get("site", "?")))
+    lines.append("events: %s held of %s recorded  %s"
+                 % (report["events_held"], report["events_total"],
+                    json.dumps(report["counts"])))
+    if report.get("watchdog"):
+        wd = report["watchdog"]
+        lines.append("")
+        lines.append("WATCHDOG TRIP: %r stuck %.1fs (timeout %.1fs) "
+                     "detail=%s" % (wd.get("tripped_site"),
+                                    wd.get("age_s", 0.0),
+                                    wd.get("timeout_s", 0.0),
+                                    json.dumps(wd.get("tripped_detail"))))
+    if report.get("exception"):
+        ex = report["exception"]
+        lines.append("")
+        lines.append("EXCEPTION: %s: %s" % (ex.get("type"), ex.get("value")))
+    if report["in_flight"]:
+        lines.append("")
+        lines.append("in flight at dump time:")
+        for e in report["in_flight"]:
+            lines.append("  %-12s age %8.3fs  thread %-12s %s"
+                         % (e.get("site"), e.get("age", 0.0),
+                            e.get("thread", "?"),
+                            json.dumps(e.get("detail"))))
+    if report["failures"]:
+        lines.append("")
+        lines.append("recent bracket failures:")
+        for e in report["failures"]:
+            lines.append("  %-12s after %7.3fs  %s — %s"
+                         % (e.get("site"), e.get("seconds", 0.0),
+                            json.dumps(e.get("detail")), e.get("error")))
+    lines.append("")
+    lines.append("last engine flushes (newest last):")
+    lines.append("  %9s %-12s %6s %6s %10s %6s"
+                 % ("age(s)", "cause", "nodes", "live", "lat(ms)", "cache"))
+    for e in report["last_flushes"]:
+        lines.append("  %9.3f %-12s %6s %6s %10.3f %6s%s"
+                     % (e.get("age_s", 0.0), e.get("cause"),
+                        e.get("nodes"), e.get("live_outputs"),
+                        e.get("latency_ms", 0.0), e.get("cache"),
+                        "  ERROR: %s" % e["error"] if "error" in e else ""))
+    if report["last_steps"]:
+        lines.append("")
+        lines.append("last steps:")
+        for e in report["last_steps"]:
+            lines.append("  %9.3fs ago  %-8s #%-6s %8.3fms  phases %s%s%s"
+                         % (e.get("age_s", 0.0), e.get("origin"),
+                            e.get("index"), e.get("latency_ms", 0.0),
+                            json.dumps(e.get("phases")),
+                            "  mem_peak %d" % e["device_mem_peak"]
+                            if "device_mem_peak" in e else "",
+                            "  ERROR %s" % (e.get("error_phase")
+                                            or e.get("error"))
+                            if ("error" in e or "error_phase" in e) else ""))
+    if report["last_collectives"]:
+        lines.append("")
+        lines.append("last collectives:")
+        for e in report["last_collectives"]:
+            lines.append("  %9.3fs ago  %-12s keys %-5s bytes %-10s "
+                         "%8.3fms rank %s"
+                         % (e.get("age_s", 0.0), e.get("path"),
+                            e.get("n_keys"), e.get("nbytes", "?"),
+                            e.get("latency_ms", 0.0), e.get("rank")))
+    if report["slow_collectives"]:
+        lines.append("")
+        lines.append("slow collectives (beyond EWMA x factor):")
+        for e in report["slow_collectives"]:
+            lines.append("  %9.3fs ago  %-12s %8.3fms (ewma %.3fms)"
+                         % (e.get("age_s", 0.0), e.get("path"),
+                            e.get("latency_ms", 0.0), e.get("ewma_ms", 0.0)))
+    if report["workers"]:
+        lines.append("")
+        lines.append("per-worker last seen (dist heartbeat):")
+        for r in sorted(report["workers"], key=str):
+            w = report["workers"][r]
+            lines.append("  rank %-4s step %-8s lag %8.3fs  info age %.3fs"
+                         % (r, w.get("step"), w.get("lag_s", 0.0),
+                            w.get("info_age_s", 0.0)))
+    return "\n".join(lines)
+
+
+def blackbox_selftest():
+    """Flight-recorder lint smoke: full-pipeline dump + schema check."""
+    from incubator_mxnet_tpu.telemetry import blackbox
+    problems = blackbox.selftest()
+    if problems:
+        for p in problems:
+            print("graftwatch selftest FAIL: %s" % p, file=sys.stderr)
+        return 1
+    print("graftwatch selftest OK (ring + brackets + dump schema valid)")
+    return 0
+
+
+def render_blackbox(path, as_json):
+    from incubator_mxnet_tpu.telemetry import blackbox
+    with open(path) as f:
+        doc = json.load(f)
+    problems = blackbox.validate_dump(doc)
+    report = blackbox.summarize_dump(doc)
+    if as_json:
+        out = dict(report, problems=problems)
+        print(json.dumps(out, indent=2, sort_keys=True, default=str))
+    else:
+        print(_render_blackbox_text(report))
+        for p in problems:
+            print("graftwatch: dump schema problem: %s" % p,
+                  file=sys.stderr)
+    return 1 if problems else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m incubator_mxnet_tpu.telemetry",
-        description="graftscope: segment-aware tracing + metrics summary")
+        description="graftscope: segment-aware tracing + metrics summary; "
+                    "graftwatch: flight-recorder post-mortems")
     ap.add_argument("--summary", action="store_true",
                     help="run (or load) a traced workload and report")
     ap.add_argument("--json", action="store_true",
@@ -175,6 +315,10 @@ def main(argv=None):
     ap.add_argument("--trace", metavar="PATH",
                     help="summarize an existing chrome-trace dump instead "
                          "of running the demo step")
+    ap.add_argument("--blackbox", metavar="PATH", nargs="?", const="",
+                    default=None,
+                    help="render a flight-recorder dump (with --selftest: "
+                         "validate the recorder pipeline instead)")
     ap.add_argument("--top", type=int,
                     default=int(os.environ.get("GRAFT_TELEMETRY_TOPK",
                                                "10")),
@@ -183,6 +327,13 @@ def main(argv=None):
                     help="trace a 3-op bulked program and validate the "
                          "dump (CI smoke tier)")
     args = ap.parse_args(argv)
+
+    if args.blackbox is not None:
+        if args.selftest:
+            return blackbox_selftest()
+        if not args.blackbox:
+            ap.error("--blackbox needs a dump PATH (or --selftest)")
+        return render_blackbox(args.blackbox, args.json)
 
     if args.selftest:
         problems = selftest()

@@ -1,0 +1,692 @@
+"""graftwatch flight recorder — the always-on black box.
+
+graftscope (metrics + tracing) only helps while the process is healthy
+and a profiler is attached.  Production TPU jobs die differently: a
+stalled collective, a device OOM, a worker that simply vanishes — and a
+multi-hour run leaves nothing to debug with.  The flight recorder is the
+answer: a bounded, lock-cheap ring buffer of structured events that is
+ALWAYS recording (independent of ``GRAFT_TELEMETRY`` and the profiler)
+and is dumped to JSON when the process dies or hangs:
+
+* engine segment flushes (cause / node count / latency / cache),
+* kvstore push/pull/reduce_many collectives (keys / bytes / rank),
+* ``Trainer.step`` / ``Module.update`` boundaries with per-phase
+  latencies and the device-memory highwater,
+* dist heartbeats (per-worker last-seen + step skew) and watchdog trips.
+
+Dump triggers: unhandled exception (``sys.excepthook`` chain), SIGTERM /
+SIGINT (handler chain), an explicit :func:`dump` call, or a watchdog
+trip (:mod:`~incubator_mxnet_tpu.telemetry.watchdog`).  The dump also
+captures what was IN FLIGHT (the open engine flush / collective / phase
+brackets) and the most recent bracket failures, so a crash mid-step
+names the phase it died in.
+
+Environment: ``GRAFT_BLACKBOX`` (default on) master switch;
+``GRAFT_BLACKBOX_SIZE`` ring capacity (default 4096 events);
+``GRAFT_BLACKBOX_PATH`` dump destination (default
+``<tmpdir>/graft_blackbox.<pid>.json``).
+
+Render a dump with ``python -m incubator_mxnet_tpu.telemetry
+--blackbox PATH [--json]``; validate one with ``--blackbox --selftest``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import traceback
+from collections import deque
+from contextlib import nullcontext as _nullcontext
+
+__all__ = ["enabled", "set_enabled", "record", "events", "stats",
+           "in_flight", "inflight_entries", "progress", "last_progress",
+           "collective", "phase_begin", "phase_end", "step_journal",
+           "workers_seen", "set_rank", "dump", "snapshot", "default_path",
+           "validate_dump", "summarize_dump", "install_hooks", "configure",
+           "selftest", "SCHEMA"]
+
+SCHEMA = "graft-blackbox/1"
+_DEFAULT_SIZE = 4096
+
+_enabled_override = None
+
+
+def set_enabled(flag):
+    """Force the recorder on/off (None = defer to GRAFT_BLACKBOX)."""
+    global _enabled_override
+    _enabled_override = flag
+
+
+def enabled():
+    if _enabled_override is not None:
+        return bool(_enabled_override)
+    return os.environ.get("GRAFT_BLACKBOX", "1").strip().lower() \
+        not in ("0", "false", "no", "off")
+
+
+def _ring_size():
+    try:
+        n = int(os.environ.get("GRAFT_BLACKBOX_SIZE", str(_DEFAULT_SIZE)))
+    except ValueError:
+        return _DEFAULT_SIZE
+    return max(n, 8)
+
+
+# the ring: deque.append is GIL-atomic and O(1) with maxlen eviction —
+# the hot path is one time.time() + one append, no lock
+_ring = deque(maxlen=_ring_size())
+_stats = [0]                    # events recorded ever (dropped = _stats[0]
+#                                 - len(_ring)); single-slot list keeps the
+#                                 increment one bytecode away from atomic —
+#                                 a lost count under contention is harmless
+_rank = [0]
+_started_at = time.time()
+
+
+def configure(size=None):
+    """Re-size the ring (tests / live re-tuning).  Keeps newest events."""
+    global _ring
+    if size is not None:
+        os.environ["GRAFT_BLACKBOX_SIZE"] = str(int(size))
+    _ring = deque(_ring, maxlen=_ring_size())
+
+
+def set_rank(rank):
+    """Stamp the dist rank onto every future dump (parallel/dist.py)."""
+    _rank[0] = int(rank)
+
+
+def record(kind, **fields):
+    """Append one structured event.  THE hot path: a disabled recorder
+    costs one env lookup; an enabled one adds one tuple + deque append."""
+    if not enabled():
+        return
+    _stats[0] += 1
+    _ring.append((time.time(), kind, fields))
+
+
+def events():
+    """Snapshot of the ring as dicts (oldest first)."""
+    return [{"ts": t, "kind": k, "data": dict(f)} for t, k, f in list(_ring)]
+
+
+def stats():
+    """Recorder status summary (benches embed this)."""
+    counts = {}
+    for _t, k, _f in list(_ring):
+        counts[k] = counts.get(k, 0) + 1
+    return {"enabled": enabled(), "ring_size": _ring_size(),
+            "events_held": len(_ring), "events_total": _stats[0],
+            "counts": counts}
+
+
+# ---------------------------------------------------------------------------
+# in-flight brackets: what the process was DOING when it died/hung
+# ---------------------------------------------------------------------------
+
+_inflight_lock = threading.Lock()
+_inflight = {}                  # thread ident -> [entry dict, ...] (stack)
+_failures = deque(maxlen=16)    # brackets that exited with an exception
+_last_progress = [time.time(), "startup"]
+
+
+def progress(site):
+    """A bracket completed: wall-clock progress for the watchdog."""
+    _last_progress[0] = time.time()
+    _last_progress[1] = site
+
+
+def last_progress():
+    return {"ts": _last_progress[0], "site": _last_progress[1],
+            "age": time.time() - _last_progress[0]}
+
+
+def _push_inflight(site, detail):
+    entry = {"site": site, "detail": detail, "since": time.time(),
+             "thread": threading.current_thread().name}
+    tid = threading.get_ident()
+    with _inflight_lock:
+        _inflight.setdefault(tid, []).append(entry)
+    return entry
+
+
+def _pop_inflight(entry, error=None):
+    tid = threading.get_ident()
+    with _inflight_lock:
+        stack = _inflight.get(tid)
+        if stack:
+            try:
+                stack.remove(entry)
+            except ValueError:
+                pass
+            if not stack:
+                _inflight.pop(tid, None)
+    if error is not None:
+        _failures.append(dict(entry, error=error,
+                              seconds=time.time() - entry["since"]))
+    else:
+        progress(entry["site"])
+
+
+def inflight_entries():
+    """Live references to the open bracket entries (the watchdog marks
+    tripped ones in place)."""
+    with _inflight_lock:
+        return [e for stack in _inflight.values() for e in stack]
+
+
+_NULL = _nullcontext()          # stateless: safe to share across threads
+
+
+class _InFlight(object):
+    __slots__ = ("site", "detail", "entry")
+
+    def __init__(self, site, detail):
+        self.site = site
+        self.detail = detail
+        self.entry = None
+
+    def __enter__(self):
+        self.entry = _push_inflight(self.site, self.detail)
+        return self
+
+    def __exit__(self, et, ev, tb):
+        _pop_inflight(self.entry, error=repr(ev) if et is not None else None)
+        return False
+
+
+def in_flight(site, detail=None):
+    """Bracket one potentially-hanging operation (engine flush, dist
+    collective): the watchdog times these, and an open bracket at dump
+    time IS the "what was it doing" answer."""
+    if not enabled():
+        return _NULL
+    return _InFlight(site, detail or {})
+
+
+# ---------------------------------------------------------------------------
+# collectives: kvstore push/pull/reduce_many brackets + slow-call EWMA
+# ---------------------------------------------------------------------------
+
+_ewma_lock = threading.Lock()
+_ewma = {}                      # path -> EWMA seconds
+_EWMA_FLOOR = 1e-3              # ignore sub-ms noise for straggler calls
+
+
+def _straggler_factor():
+    try:
+        return float(os.environ.get("GRAFT_STRAGGLER_FACTOR", "3"))
+    except ValueError:
+        return 3.0
+
+
+class _Collective(object):
+    __slots__ = ("path", "fields", "entry", "_t0")
+
+    def __init__(self, path, fields):
+        self.path = path
+        self.fields = fields
+        self.entry = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self.entry = _push_inflight(
+            "collective", dict(self.fields, path=self.path))
+        return self
+
+    def __exit__(self, et, ev, tb):
+        dt = time.perf_counter() - self._t0
+        err = repr(ev) if et is not None else None
+        _pop_inflight(self.entry, error=err)
+        fields = dict(self.fields, path=self.path, rank=_rank[0],
+                      latency_ms=round(dt * 1e3, 3))
+        if err is not None:
+            fields["error"] = err
+        record("collective", **fields)
+        if err is None:
+            self._straggler_check(dt)
+        return False
+
+    def _straggler_check(self, dt):
+        """Slow-collective detection: a call beyond ``factor`` × its own
+        EWMA (per path) earns a log line + a ring event.  The EWMA only
+        updates on healthy calls so one straggler can't poison it."""
+        factor = _straggler_factor()
+        with _ewma_lock:
+            prev = _ewma.get(self.path)
+            slow = (prev is not None and prev > _EWMA_FLOOR
+                    and dt > factor * prev)
+            if not slow:
+                _ewma[self.path] = dt if prev is None \
+                    else 0.8 * prev + 0.2 * dt
+        if slow:
+            record("slow_collective", path=self.path, rank=_rank[0],
+                   latency_ms=round(dt * 1e3, 3),
+                   ewma_ms=round(prev * 1e3, 3), factor=factor)
+            from . import metrics as _metrics
+            _metrics.collective_slow(self.path)
+            import logging
+            logging.getLogger("graftwatch").warning(
+                "slow collective: %s took %.1fms (EWMA %.1fms, factor %g) "
+                "on rank %d", self.path, dt * 1e3, prev * 1e3, factor,
+                _rank[0])
+
+
+def collective(path, **fields):
+    """Bracket one kvstore collective (push/pull/reduce_many/ps_*):
+    records a ``collective`` ring event with latency + key/byte counts,
+    feeds the straggler EWMA, and shows up in-flight while running."""
+    if not enabled():
+        return _NULL
+    return _Collective(path, fields)
+
+
+# ---------------------------------------------------------------------------
+# step journal: Trainer.step / Module.update boundaries with phase latencies
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()
+_step_counters = {}
+
+
+def phase_begin(phase):
+    """Called by tracing._PhaseSpan.__enter__: the phase becomes an
+    in-flight bracket so a crash/hang mid-phase names it."""
+    if not enabled():
+        return None
+    return _push_inflight("phase", {"phase": phase})
+
+
+def phase_end(entry, phase, seconds, error=False):
+    """Close the phase bracket; latency lands on the open step journal
+    (or its own ring event when no step is open, e.g. Module fwd/bwd)."""
+    if entry is not None:
+        _pop_inflight(entry, error="exception in phase %r" % phase
+                      if error else None)
+    if not enabled():
+        return
+    j = getattr(_tls, "step", None)
+    if j is not None:
+        j["phases"][phase] = j["phases"].get(phase, 0.0) + seconds
+        if error:
+            j["error_phase"] = phase
+    else:
+        fields = {"phase": phase, "seconds": round(seconds, 6)}
+        if error:
+            fields["error"] = True
+        record("phase", **fields)
+
+
+def _device_mem_peak():
+    """Cheap device-memory highwater: allocator counters only (the
+    live_arrays fallback walk is too slow for a per-step journal)."""
+    try:
+        import jax
+        total, found = 0, False
+        for d in jax.local_devices():
+            s = d.memory_stats() or {}
+            if "peak_bytes_in_use" in s:
+                total += int(s.get("peak_bytes_in_use", 0))
+                found = True
+        return total if found else None
+    except Exception:
+        return None
+
+
+class _StepJournal(object):
+    __slots__ = ("origin", "fields", "entry", "journal", "prev", "_t0")
+
+    def __init__(self, origin, fields):
+        self.origin = origin
+        self.fields = fields
+
+    def __enter__(self):
+        index = _step_counters[self.origin] = \
+            _step_counters.get(self.origin, 0) + 1
+        self._t0 = time.perf_counter()
+        self.journal = {"phases": {}}
+        self.prev = getattr(_tls, "step", None)
+        _tls.step = self.journal
+        self.entry = _push_inflight(
+            "step", dict(self.fields, origin=self.origin, index=index))
+        return self
+
+    def __exit__(self, et, ev, tb):
+        _tls.step = self.prev
+        err = repr(ev) if et is not None else None
+        _pop_inflight(self.entry, error=err)
+        fields = dict(self.fields, origin=self.origin,
+                      index=self.entry["detail"]["index"],
+                      latency_ms=round(
+                          (time.perf_counter() - self._t0) * 1e3, 3),
+                      phases={k: round(v, 6)
+                              for k, v in self.journal["phases"].items()})
+        mem = _device_mem_peak()
+        if mem is not None:
+            fields["device_mem_peak"] = mem
+        if "error_phase" in self.journal:
+            fields["error_phase"] = self.journal["error_phase"]
+        if err is not None:
+            fields["error"] = err
+        record("step", **fields)
+        return False
+
+
+def step_journal(origin, **fields):
+    """Bracket one optimizer step (gluon ``Trainer.step`` /
+    ``Module.update``): phase latencies recorded inside land on ONE
+    ``step`` ring event with the device-memory highwater."""
+    if not enabled():
+        return _NULL
+    return _StepJournal(origin, fields)
+
+
+# ---------------------------------------------------------------------------
+# dist worker table (straggler view)
+# ---------------------------------------------------------------------------
+
+_workers_lock = threading.Lock()
+_workers = {}                   # rank -> {"step", "lag_s", "at"}
+
+
+def workers_seen(table, skew=None, step=None):
+    """Update the per-worker last-seen table from one dist heartbeat
+    (parallel/dist.py piggybacks it on the kvstore sync path)."""
+    if not enabled():
+        return
+    now = time.time()
+    with _workers_lock:
+        for r, info in table.items():
+            _workers[int(r)] = dict(info, at=now)
+    fields = {"workers": len(table)}
+    if skew is not None:
+        fields["skew_s"] = round(float(skew), 6)
+    if step is not None:
+        fields["step"] = int(step)
+    record("dist_heartbeat", **fields)
+
+
+# ---------------------------------------------------------------------------
+# dump
+# ---------------------------------------------------------------------------
+
+def default_path():
+    return os.environ.get("GRAFT_BLACKBOX_PATH") or os.path.join(
+        tempfile.gettempdir(), "graft_blackbox.%d.json" % os.getpid())
+
+
+def _thread_stacks():
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for ident, frame in frames.items():
+        label = "%s (%d)" % (names.get(ident, "?"), ident)
+        out[label] = traceback.format_stack(frame)
+    return out
+
+
+def snapshot(reason="manual", extra=None):
+    """The dump document (JSON-able).  Includes the ring, the open
+    in-flight brackets, recent bracket failures, the per-worker
+    last-seen table, and formatted thread stacks."""
+    now = time.time()
+    with _inflight_lock:
+        infl = [dict(e, age=round(now - e["since"], 6))
+                for stack in _inflight.values() for e in stack]
+    with _workers_lock:
+        workers = {str(r): dict(v) for r, v in _workers.items()}
+    doc = {
+        "schema": SCHEMA,
+        "pid": os.getpid(),
+        "rank": _rank[0],
+        "reason": reason,
+        "dumped_at": now,
+        "started_at": _started_at,
+        "ring_size": _ring_size(),
+        "events_total": _stats[0],
+        "last_progress": last_progress(),
+        "in_flight": infl,
+        "failures": [dict(f) for f in _failures],
+        "workers": workers,
+        "events": events(),
+        "threads": _thread_stacks(),
+    }
+    if extra:
+        doc.update(extra)
+    return doc
+
+
+def dump(path=None, reason="manual", extra=None):
+    """Write the flight-recorder dump; returns the path (or None when
+    the write failed — a dying process must not die twice)."""
+    path = path or default_path()
+    doc = snapshot(reason=reason, extra=extra)
+    try:
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True, default=str)
+    except OSError:
+        return None
+    record("dump", path=path, reason=reason)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# crash hooks: unhandled exception + SIGTERM/SIGINT
+# ---------------------------------------------------------------------------
+
+_hooks_installed = [False]
+_signals_installed = [False]
+_prev_excepthook = None
+_prev_signals = {}
+
+
+def _excepthook(exc_type, exc, tb):
+    try:
+        if enabled() and (_ring or inflight_entries()):
+            frames = traceback.format_exception(exc_type, exc, tb)
+            dump(reason="exception", extra={"exception": {
+                "type": getattr(exc_type, "__name__", str(exc_type)),
+                "value": str(exc),
+                "traceback": frames[-20:],
+            }})
+    except Exception:
+        pass                    # never mask the original crash
+    if _prev_excepthook is not None:
+        _prev_excepthook(exc_type, exc, tb)
+
+
+def _signal_handler(signum, frame):
+    try:
+        if enabled() and (_ring or inflight_entries()):
+            dump(reason="signal:%d" % signum)
+    except Exception:
+        pass
+    prev = _prev_signals.get(signum)
+    import signal as _signal
+    if callable(prev):
+        prev(signum, frame)
+    else:
+        # restore the default disposition and re-raise so the exit code
+        # still says "killed by signal"
+        _signal.signal(signum, _signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+
+def install_hooks():
+    """Chain the excepthook + SIGTERM/SIGINT handlers (idempotent).  A
+    signal the process explicitly IGNORES (SIG_IGN — e.g. worker pools
+    parking SIGINT) is left alone: chaining over it would turn an
+    ignored signal fatal.  A non-main-thread call skips the signal half
+    WITHOUT latching it, so a later main-thread call (telemetry re-init,
+    ``watchdog.start``) still gets to install the handlers."""
+    global _prev_excepthook
+    if not _hooks_installed[0]:
+        _hooks_installed[0] = True
+        _prev_excepthook = sys.excepthook
+        sys.excepthook = _excepthook
+    if _signals_installed[0]:
+        return
+    import signal as _signal
+    try:
+        for signum in (_signal.SIGTERM, _signal.SIGINT):
+            if signum not in _prev_signals \
+                    and _signal.getsignal(signum) is not _signal.SIG_IGN:
+                _prev_signals[signum] = _signal.signal(signum,
+                                                       _signal_handler)
+        _signals_installed[0] = True
+    except ValueError:          # not the main thread: retry later
+        pass
+
+
+# ---------------------------------------------------------------------------
+# dump validation + summary (the --blackbox CLI rides these)
+# ---------------------------------------------------------------------------
+
+def validate_dump(doc):
+    """Schema check of a dump document.  Returns a list of problems
+    (empty == valid) — same contract as tracing.validate_chrome_trace."""
+    problems = []
+    if not isinstance(doc, dict):
+        return ["dump is not a JSON object"]
+    if doc.get("schema") != SCHEMA:
+        problems.append("schema is %r, expected %r"
+                        % (doc.get("schema"), SCHEMA))
+    for key, typ in (("pid", int), ("reason", str), ("dumped_at", (int, float)),
+                     ("ring_size", int), ("events_total", int),
+                     ("events", list), ("in_flight", list),
+                     ("failures", list), ("workers", dict),
+                     ("last_progress", dict)):
+        if key not in doc:
+            problems.append("missing key %r" % key)
+        elif not isinstance(doc[key], typ):
+            problems.append("key %r has type %s" % (key,
+                                                    type(doc[key]).__name__))
+    for i, e in enumerate(doc.get("events") or []):
+        if not isinstance(e, dict):
+            problems.append("event %d: not an object" % i)
+            continue
+        if not isinstance(e.get("ts"), (int, float)):
+            problems.append("event %d: missing/invalid ts" % i)
+        if not isinstance(e.get("kind"), str) or not e.get("kind"):
+            problems.append("event %d: missing/invalid kind" % i)
+        if not isinstance(e.get("data"), dict):
+            problems.append("event %d: missing/invalid data" % i)
+    for i, e in enumerate(doc.get("in_flight") or []):
+        if not isinstance(e, dict) or "site" not in e or "since" not in e:
+            problems.append("in_flight %d: missing site/since" % i)
+    if isinstance(doc.get("events"), list) and \
+            isinstance(doc.get("events_total"), int) and \
+            doc["events_total"] < len(doc["events"]):
+        problems.append("events_total < events held (counter went backwards)")
+    return problems
+
+
+def summarize_dump(doc, last=10):
+    """Reconstruct the final timeline from a dump: the last flushes,
+    steps and collectives, what was in flight, per-worker last-seen."""
+    evs = doc.get("events") or []
+    t_dump = doc.get("dumped_at", 0.0)
+
+    def tail(kind, n=last):
+        rows = [e for e in evs if e.get("kind") == kind]
+        return [dict(e["data"], age_s=round(t_dump - e["ts"], 3))
+                for e in rows[-n:]]
+
+    counts = {}
+    for e in evs:
+        counts[e.get("kind", "?")] = counts.get(e.get("kind", "?"), 0) + 1
+    workers = {r: dict(v, info_age_s=round(t_dump - v.get("at", t_dump), 3))
+               for r, v in (doc.get("workers") or {}).items()}
+    return {
+        "reason": doc.get("reason"),
+        "pid": doc.get("pid"),
+        "rank": doc.get("rank"),
+        "dumped_at": t_dump,
+        "events_total": doc.get("events_total"),
+        "events_held": len(evs),
+        "counts": counts,
+        "last_progress": doc.get("last_progress"),
+        "in_flight": doc.get("in_flight") or [],
+        "failures": doc.get("failures") or [],
+        "last_flushes": tail("engine_flush"),
+        "last_steps": tail("step", 5),
+        "last_collectives": tail("collective", 5),
+        "slow_collectives": tail("slow_collective", 5),
+        "watchdog": doc.get("watchdog"),
+        "exception": doc.get("exception"),
+        "workers": workers,
+    }
+
+
+def selftest():
+    """Exercise the full recorder pipeline on a tiny real workload and
+    validate the dump schema (the lint smoke tier).  Returns a list of
+    problems — empty means pass."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import engine
+
+    prev_override = _enabled_override
+    prev_size = os.environ.get("GRAFT_BLACKBOX_SIZE")
+    set_enabled(True)
+    configure(size=_DEFAULT_SIZE)   # pin: an ambient tiny ring (legal
+    #                                 config) must not evict the events
+    #                                 this smoke asserts on
+    held = None
+    path = None
+    try:
+        a = mx.nd.array(np.ones((4, 4), np.float32))
+        for _ in range(10):                      # >= 8 engine_flush events
+            with engine.bulk(8):
+                ((a * a) + a).asnumpy()
+        kv = mx.kv.create("local")
+        kv.init("bb", mx.nd.ones((4,)))
+        kv.push("bb", mx.nd.ones((4,)))
+        out = mx.nd.zeros((4,))
+        kv.pull("bb", out=out)
+        with step_journal("selftest", batch_size=1):
+            from . import tracing
+            with tracing.phase_span("update"):
+                (a + 1).asnumpy()
+        held = _push_inflight("selftest", {"why": "held open across dump"})
+        fd, path = tempfile.mkstemp(suffix=".json", prefix="graft_bb_self_")
+        os.close(fd)
+        dump(path=path, reason="selftest")
+        with open(path) as f:
+            doc = json.load(f)
+        problems = validate_dump(doc)
+        flushes = [e for e in doc["events"] if e["kind"] == "engine_flush"]
+        if len(flushes) < 8:
+            problems.append("expected >= 8 engine_flush events, got %d"
+                            % len(flushes))
+        if not any(e["kind"] == "collective" for e in doc["events"]):
+            problems.append("no collective events (kvstore brackets gone)")
+        steps = [e for e in doc["events"] if e["kind"] == "step"]
+        if not steps:
+            problems.append("no step events (step journal gone)")
+        elif "update" not in steps[-1]["data"].get("phases", {}):
+            problems.append("step event lost its phase latencies")
+        if not any(e.get("site") == "selftest" for e in doc["in_flight"]):
+            problems.append("held-open bracket missing from in_flight")
+        try:
+            summarize_dump(doc)
+        except Exception as exc:
+            problems.append("summarize_dump raised: %r" % exc)
+        return problems
+    finally:
+        if held is not None:
+            _pop_inflight(held)
+        if path:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        set_enabled(prev_override)
+        if prev_size is None:
+            os.environ.pop("GRAFT_BLACKBOX_SIZE", None)
+        else:
+            os.environ["GRAFT_BLACKBOX_SIZE"] = prev_size
+        configure()

@@ -82,10 +82,16 @@ class _Metric(object):
         return dict(zip(self.labelnames, key))
 
     def samples(self):
-        """[(labels dict, sample payload)] — payload shape is per-kind."""
+        """[(labels dict, sample payload)] — payload shape is per-kind.
+
+        The export runs UNDER the lock: a histogram payload reads
+        several list slots, and exporting outside the lock let a
+        concurrent ``observe`` tear the snapshot (bucket counts from
+        one observation, sum from the next) — the watchdog samples from
+        a background thread, so snapshots must be self-consistent."""
         with self._lock:
-            items = list(self._series.items())
-        return [(self.labels_of(k), self._export(s)) for k, s in items]
+            return [(self.labels_of(k), self._export(s))
+                    for k, s in self._series.items()]
 
 
 class Counter(_Metric):
@@ -552,6 +558,62 @@ def trainer_fused_update(n_params):
     r.counter("graft_trainer_bucket_fused_params_total",
               "Parameters updated through fused bucket dispatches").inc(
         n_params)
+
+
+# -- graftwatch: watchdog + dist liveness ------------------------------------
+
+_SKEW_BUCKETS = (1e-4, 1e-3, 1e-2, 0.1, 0.5, 1.0, 5.0, 30.0)
+
+
+def watchdog_status(n_inflight, oldest_age, progress_age):
+    """One watchdog poll: liveness gauges refreshed from the background
+    thread (telemetry/watchdog.py)."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    r.gauge("graft_watchdog_inflight",
+            "Open flight-recorder brackets (flushes/collectives/phases)"
+            ).set(n_inflight)
+    r.gauge("graft_watchdog_oldest_inflight_seconds",
+            "Age of the oldest open bracket").set(oldest_age)
+    r.gauge("graft_watchdog_progress_age_seconds",
+            "Wall-clock seconds since the last bracket completed").set(
+        progress_age)
+
+
+def watchdog_trip(site):
+    """One declared hang (per tripped bracket site)."""
+    if not enabled():
+        return
+    _REGISTRY.counter("graft_watchdog_trips_total",
+                      "Watchdog hang declarations", ("site",)).inc(site=site)
+
+
+def dist_dead_nodes(n):
+    """Workers whose heartbeats stopped (DistKVStore.num_dead_nodes)."""
+    if not enabled():
+        return
+    _REGISTRY.gauge("graft_dist_dead_nodes",
+                    "Workers whose parameter-service heartbeats stopped"
+                    ).set(n)
+
+
+def dist_worker_skew(seconds):
+    """Per-step cross-worker arrival skew from the dist heartbeat."""
+    if not enabled():
+        return
+    _REGISTRY.histogram("graft_dist_worker_skew_seconds",
+                        "Per-step worker arrival skew (dist heartbeat)", (),
+                        buckets=_SKEW_BUCKETS).observe(seconds)
+
+
+def collective_slow(path):
+    """One collective beyond GRAFT_STRAGGLER_FACTOR x its own EWMA."""
+    if not enabled():
+        return
+    _REGISTRY.counter("graft_dist_slow_collectives_total",
+                      "Collectives slower than the straggler threshold",
+                      ("path",)).inc(path=path)
 
 
 _REGISTRY.register_collector(_collect_device_memory)

@@ -28,6 +28,7 @@ from __future__ import annotations
 import itertools
 import time
 
+from . import blackbox as _blackbox
 from . import metrics as _metrics
 
 __all__ = ["phase_span", "next_segment_id", "record_active",
@@ -73,18 +74,22 @@ def deferred_op_event(name, begin_us, end_us, segment, index):
 
 def segment_flush_span(segment, cause, begin_us, end_us, flow_indices,
                        program_len, live_outputs, cache_hit, recorded,
-                       device_time):
+                       device_time, error=False):
     """The flush span + one flow finish per op that emitted a flow start
     (``flow_indices`` — only those, so a profiler toggled mid-segment
-    never leaves a dangling arrow)."""
+    never leaves a dangling arrow).  ``error`` marks a flush whose
+    replay raised — the span STILL closes its flow links so crash-time
+    traces validate (no dangling ``s`` events)."""
     p = _prof()
-    p.record_event(SEGMENT_SPAN, begin_us, end_us, cat="engine",
-                   args={"segment": segment, "cause": cause,
-                         "nodes": program_len,
-                         "live_outputs": live_outputs,
-                         "cache": "hit" if cache_hit else "miss",
-                         "recorded": bool(recorded),
-                         "device_time": bool(device_time)})
+    args = {"segment": segment, "cause": cause,
+            "nodes": program_len,
+            "live_outputs": live_outputs,
+            "cache": "hit" if cache_hit else "miss",
+            "recorded": bool(recorded),
+            "device_time": bool(device_time)}
+    if error:
+        args["error"] = True
+    p.record_event(SEGMENT_SPAN, begin_us, end_us, cat="engine", args=args)
     # bind each flow to the enclosing flush slice (bp: "e")
     ts = begin_us + min(1.0, max(end_us - begin_us, 0.0) / 2)
     for i in flow_indices:
@@ -96,9 +101,13 @@ def segment_flush_span(segment, cause, begin_us, end_us, flow_indices,
 
 class _PhaseSpan(object):
     """Times one training-loop phase; emits a chrome event (cat "phase")
-    when the profiler runs and always feeds graft_phase_seconds."""
+    when the profiler runs and always feeds graft_phase_seconds.  The
+    span closes on the exception path too — the chrome event (marked
+    ``error``), the histogram observation AND the flight-recorder phase
+    bracket all land, so a crash mid-phase leaves a well-formed trace
+    and a dump that names the phase."""
 
-    __slots__ = ("phase", "args", "_begin", "_t0")
+    __slots__ = ("phase", "args", "_begin", "_t0", "_bb")
 
     def __init__(self, phase, args=None):
         self.phase = phase
@@ -107,17 +116,23 @@ class _PhaseSpan(object):
     def __enter__(self):
         self._t0 = time.perf_counter()
         self._begin = _prof()._now_us()
+        self._bb = _blackbox.phase_begin(self.phase)
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, exc_type, exc, tb):
+        dt = time.perf_counter() - self._t0
         p = _prof()
         if p._P.active():
             args = {"phase": self.phase}
+            if exc_type is not None:
+                args["error"] = True
             if self.args:
                 args.update(self.args)
             p.record_event(self.phase, self._begin, p._now_us(),
                            cat="phase", args=args)
-        _metrics.phase(self.phase, time.perf_counter() - self._t0)
+        _metrics.phase(self.phase, dt)
+        _blackbox.phase_end(self._bb, self.phase, dt,
+                            error=exc_type is not None)
         return False
 
 
@@ -136,8 +151,9 @@ _NULL = _NullSpan()
 
 def phase_span(phase, args=None):
     """Context manager for one fwd/bwd/update/kvstore phase.  Free when
-    both the profiler and telemetry are off."""
-    if not _metrics.enabled() and not _prof()._P.active():
+    the profiler, telemetry AND the flight recorder are all off."""
+    if not _metrics.enabled() and not _prof()._P.active() \
+            and not _blackbox.enabled():
         return _NULL
     return _PhaseSpan(phase, args)
 

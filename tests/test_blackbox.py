@@ -1,0 +1,494 @@
+"""graftwatch tests: flight-recorder ring, crash dumps, watchdog trips,
+straggler detection, and the post-mortem CLI.
+
+Covers the ISSUE-6 acceptance surface: ring-buffer wraparound, a
+subprocess that raises mid-``Trainer.step`` leaving a schema-valid dump
+naming the failing phase with the last >= 8 engine flushes, a
+monkeypatched stalled flush tripping the watchdog within the configured
+timeout (the dump names the stuck segment), the worker-skew histogram in
+the 2-proc dist harness, and the ``--blackbox --selftest`` schema check.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import engine, gluon
+from incubator_mxnet_tpu import telemetry
+from incubator_mxnet_tpu.telemetry import blackbox, watchdog
+from incubator_mxnet_tpu.telemetry import tracing as ttracing
+
+
+@pytest.fixture
+def recorder():
+    """A clean, force-enabled recorder for one test."""
+    blackbox.set_enabled(True)
+    blackbox._ring.clear()
+    blackbox._failures.clear()
+    yield blackbox
+    blackbox.set_enabled(None)
+
+
+def _kinds(evs):
+    return [e["kind"] for e in evs]
+
+
+# ---------------------------------------------------------------------------
+# ring buffer
+# ---------------------------------------------------------------------------
+
+def test_ring_wraparound(recorder):
+    try:
+        blackbox.configure(size=8)
+        for i in range(20):
+            blackbox.record("tick", i=i)
+        evs = blackbox.events()
+        assert len(evs) == 8                      # bounded
+        assert [e["data"]["i"] for e in evs] == list(range(12, 20))
+        st = blackbox.stats()
+        assert st["events_total"] >= 20           # total keeps counting
+        assert st["events_held"] == 8
+        assert st["counts"] == {"tick": 8}
+    finally:
+        os.environ.pop("GRAFT_BLACKBOX_SIZE", None)
+        blackbox.configure()                      # back to the default
+
+
+def test_disabled_recorder_is_a_noop(recorder):
+    blackbox.set_enabled(False)
+    before = len(blackbox.events())
+    blackbox.record("tick")
+    with blackbox.in_flight("x"):
+        pass
+    with blackbox.collective("push", n_keys=1):
+        pass
+    assert len(blackbox.events()) == before
+
+
+def test_ring_size_floor_and_env(recorder):
+    try:
+        os.environ["GRAFT_BLACKBOX_SIZE"] = "2"   # below the floor of 8
+        blackbox.configure()
+        for i in range(10):
+            blackbox.record("tick", i=i)
+        assert len(blackbox.events()) == 8
+    finally:
+        os.environ.pop("GRAFT_BLACKBOX_SIZE", None)
+        blackbox.configure()
+
+
+# ---------------------------------------------------------------------------
+# subsystem events
+# ---------------------------------------------------------------------------
+
+def test_engine_flush_events(recorder):
+    a = mx.nd.ones((6, 6))
+    for _ in range(3):
+        with engine.bulk(8):
+            ((a * a) + a).asnumpy()
+    flushes = [e for e in blackbox.events() if e["kind"] == "engine_flush"]
+    assert len(flushes) >= 3
+    d = flushes[-1]["data"]
+    assert d["cause"] in ("read", "scope-close")
+    assert d["nodes"] == 2
+    assert d["cache"] in ("hit", "miss")
+    assert d["latency_ms"] >= 0
+    assert "error" not in d
+
+
+def test_kvstore_collective_events(recorder):
+    kv = mx.kv.create("local")
+    kv.init("k", mx.nd.ones((8,)))
+    kv.push("k", mx.nd.ones((8,)))
+    out = mx.nd.zeros((8,))
+    kv.pull("k", out=out)
+    kv.reduce_many([mx.nd.ones((4,))])
+    colls = [e["data"] for e in blackbox.events()
+             if e["kind"] == "collective"]
+    paths = [c["path"] for c in colls]
+    assert "push" in paths and "pull" in paths and "reduce_many" in paths
+    push = next(c for c in colls if c["path"] == "push")
+    assert push["nbytes"] == 32 and push["n_keys"] == 1
+    assert push["rank"] == 0 and push["latency_ms"] >= 0
+    pull = next(c for c in colls if c["path"] == "pull")
+    assert pull["nbytes"] == 32
+
+
+def test_slow_collective_detection(recorder):
+    for _ in range(4):                  # prime the EWMA above the floor
+        with blackbox.collective("probe"):
+            time.sleep(0.004)
+    with blackbox.collective("probe"):  # ~10x the EWMA
+        time.sleep(0.04)
+    slow = [e["data"] for e in blackbox.events()
+            if e["kind"] == "slow_collective"]
+    assert slow and slow[-1]["path"] == "probe"
+    assert slow[-1]["latency_ms"] > slow[-1]["ewma_ms"]
+    snap = telemetry.compact_snapshot()
+    assert snap.get('graft_dist_slow_collectives_total{path="probe"}',
+                    0) >= 1
+
+
+def test_step_journal_records_phases_and_memory(recorder):
+    with blackbox.step_journal("trainer", batch_size=4):
+        with ttracing.phase_span("kvstore"):
+            pass
+        with ttracing.phase_span("update"):
+            time.sleep(0.002)
+    steps = [e["data"] for e in blackbox.events() if e["kind"] == "step"]
+    assert steps
+    s = steps[-1]
+    assert s["origin"] == "trainer" and s["batch_size"] == 4
+    assert set(s["phases"]) == {"kvstore", "update"}
+    assert s["phases"]["update"] >= 0.002
+    assert s["latency_ms"] >= 2.0
+
+
+def test_step_journal_names_failing_phase(recorder):
+    with pytest.raises(RuntimeError):
+        with blackbox.step_journal("trainer", batch_size=1):
+            with ttracing.phase_span("update"):
+                raise RuntimeError("boom")
+    steps = [e["data"] for e in blackbox.events() if e["kind"] == "step"]
+    assert steps[-1]["error_phase"] == "update"
+    assert "error" in steps[-1]
+    fails = blackbox.snapshot()["failures"]
+    assert any(f["site"] == "phase" and f["detail"]["phase"] == "update"
+               for f in fails)
+
+
+def test_trainer_step_emits_journal(recorder):
+    p = gluon.Parameter("w", shape=(4, 4))
+    p.initialize(ctx=mx.cpu())
+    p.data()._write(np.ones((4, 4), np.float32))
+    p.grad()._write(np.ones((4, 4), np.float32))
+    tr = gluon.Trainer([p], "sgd", {"learning_rate": 0.1}, kvstore=None)
+    tr.step(1)
+    steps = [e["data"] for e in blackbox.events() if e["kind"] == "step"]
+    assert steps and steps[-1]["origin"] == "trainer"
+    assert "update" in steps[-1]["phases"]
+
+
+# ---------------------------------------------------------------------------
+# dump + schema
+# ---------------------------------------------------------------------------
+
+def test_dump_validates_and_summarizes(recorder, tmp_path):
+    a = mx.nd.ones((3, 3))
+    with engine.bulk(8):
+        (a + a).asnumpy()
+    with blackbox.in_flight("probe", {"why": "held"}):
+        path = blackbox.dump(path=str(tmp_path / "bb.json"),
+                             reason="manual")
+    with open(path) as f:
+        doc = json.load(f)
+    assert blackbox.validate_dump(doc) == []
+    assert doc["reason"] == "manual" and doc["pid"] == os.getpid()
+    assert any(e["site"] == "probe" for e in doc["in_flight"])
+    assert any(t for t in doc["threads"])         # formatted stacks
+    report = blackbox.summarize_dump(doc)
+    assert report["last_flushes"]
+    assert report["counts"]["engine_flush"] >= 1
+
+
+def test_validate_dump_rejects_malformed(recorder):
+    assert blackbox.validate_dump([]) == ["dump is not a JSON object"]
+    doc = blackbox.snapshot()
+    bad = dict(doc, schema="nope")
+    assert any("schema" in p for p in blackbox.validate_dump(bad))
+    bad = dict(doc, events=[{"kind": "x", "data": {}}])   # no ts
+    assert any("ts" in p for p in blackbox.validate_dump(bad))
+    bad = dict(doc)
+    bad.pop("in_flight")
+    assert any("in_flight" in p for p in blackbox.validate_dump(bad))
+
+
+def test_cli_blackbox_selftest():
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ, PYTHONPATH=os.path.abspath(repo) + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run(
+        [sys.executable, "-m", "incubator_mxnet_tpu.telemetry",
+         "--blackbox", "--selftest"],
+        capture_output=True, text=True, env=env, timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "graftwatch selftest OK" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# crash post-mortem: a subprocess raising mid-Trainer.step
+# ---------------------------------------------------------------------------
+
+_CRASH_SCRIPT = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["GRAFT_BLACKBOX_PATH"] = sys.argv[1]
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import engine, gluon
+
+    a = mx.nd.array(np.ones((4, 4), np.float32))
+    for _ in range(10):                    # >= 8 engine_flush ring events
+        with engine.bulk(8):
+            ((a * a) + a).asnumpy()
+
+    p = gluon.Parameter("w", shape=(4, 4))
+    p.initialize(ctx=mx.cpu())
+    p.data()._write(np.ones((4, 4), np.float32))
+    p.grad()._write(np.ones((4, 4), np.float32))
+    tr = gluon.Trainer([p], "sgd", {"learning_rate": 0.1}, kvstore=None)
+    tr.step(1)                             # one healthy step first
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic mid-step crash")
+    tr._bucketed_update = boom
+    tr._update = boom
+    tr.step(1)                             # dies inside the update phase
+""")
+
+
+def test_crash_mid_step_leaves_valid_dump(tmp_path):
+    dump_path = str(tmp_path / "crash.json")
+    script = tmp_path / "crash.py"
+    script.write_text(_CRASH_SCRIPT)
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    env = dict(os.environ, PYTHONPATH=repo + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run([sys.executable, str(script), dump_path],
+                       capture_output=True, text=True, env=env,
+                       timeout=180)
+    assert r.returncode != 0
+    assert "synthetic mid-step crash" in r.stderr
+    with open(dump_path) as f:
+        doc = json.load(f)
+    # the dump passes the schema the CLI selftest enforces
+    assert blackbox.validate_dump(doc) == []
+    assert doc["reason"] == "exception"
+    assert doc["exception"]["type"] == "RuntimeError"
+    flushes = [e for e in doc["events"] if e["kind"] == "engine_flush"]
+    assert len(flushes) >= 8
+    # the in-flight phase at crash time is named: the phase bracket
+    # closed WITH the error, landing in failures + the step event
+    assert any(f["site"] == "phase" and f["detail"]["phase"] == "update"
+               for f in doc["failures"])
+    steps = [e["data"] for e in doc["events"] if e["kind"] == "step"]
+    assert steps[-1].get("error_phase") == "update"
+    # and the renderer reconstructs the timeline from it
+    rr = subprocess.run(
+        [sys.executable, "-m", "incubator_mxnet_tpu.telemetry",
+         "--blackbox", dump_path, "--json"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert rr.returncode == 0, rr.stdout + rr.stderr
+    report = json.loads(rr.stdout)
+    assert report["problems"] == []
+    assert report["exception"]["type"] == "RuntimeError"
+    assert len(report["last_flushes"]) >= 8
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_trips_on_stalled_flush(recorder, monkeypatch, tmp_path):
+    dump_path = str(tmp_path / "wd.json")
+    orig_build = engine._build_replay
+
+    def slow_build(instrs, live):
+        replay = orig_build(instrs, live)
+
+        def slow(ext):
+            time.sleep(1.2)               # the synthetic stalled flush
+            return replay(ext)
+        return slow
+
+    monkeypatch.setattr(engine, "_build_replay", slow_build)
+    wd = watchdog.start(timeout=0.3, interval=0.05, abort=False,
+                        path=dump_path)
+    assert wd is not None
+    try:
+        a = mx.nd.array(np.ones((5, 9), np.float32))  # unique: cache miss
+        t0 = time.perf_counter()
+        with engine.bulk(8):
+            ((a * a) + a).asnumpy()
+        stall = time.perf_counter() - t0
+        deadline = time.time() + 2
+        while wd.trips == 0 and time.time() < deadline:
+            time.sleep(0.02)
+        assert wd.trips == 1
+    finally:
+        watchdog.stop()
+    with open(dump_path) as f:
+        doc = json.load(f)
+    assert blackbox.validate_dump(doc) == []
+    assert doc["reason"] == "watchdog"
+    # the dump names the stuck segment, and the trip landed within the
+    # configured timeout (+ polling slack), well before the stall ended
+    wdinfo = doc["watchdog"]
+    assert wdinfo["tripped_site"] == "engine_flush"
+    assert wdinfo["tripped_detail"]["cause"] == "read"
+    assert wdinfo["tripped_detail"]["nodes"] == 2
+    assert "segment" in wdinfo["tripped_detail"]
+    assert 0.3 <= wdinfo["age_s"] < stall
+    inflight = [e for e in doc["in_flight"] if e["site"] == "engine_flush"]
+    assert inflight and inflight[0]["detail"]["cause"] == "read"
+    trips = [e for e in doc["events"] if e["kind"] == "watchdog_trip"]
+    assert trips and trips[-1]["data"]["site"] == "engine_flush"
+    snap = telemetry.compact_snapshot()
+    assert snap.get(
+        'graft_watchdog_trips_total{site="engine_flush"}', 0) >= 1
+
+
+def test_watchdog_idle_process_never_trips(recorder):
+    wd = watchdog.start(timeout=0.05, interval=0.02, abort=False)
+    try:
+        time.sleep(0.2)                   # idle: nothing in flight
+        assert wd.trips == 0
+    finally:
+        watchdog.stop()
+
+
+def test_watchdog_env_configuration(monkeypatch):
+    monkeypatch.delenv("GRAFT_WATCHDOG_TIMEOUT", raising=False)
+    assert watchdog.configured_timeout() is None
+    assert watchdog.start() is None       # no timeout -> no thread
+    monkeypatch.setenv("GRAFT_WATCHDOG_TIMEOUT", "2.5")
+    assert watchdog.configured_timeout() == 2.5
+    monkeypatch.setenv("GRAFT_WATCHDOG_TIMEOUT", "0")
+    assert watchdog.configured_timeout() is None
+    monkeypatch.setenv("GRAFT_WATCHDOG_TIMEOUT", "nope")
+    assert watchdog.configured_timeout() is None
+
+
+def test_watchdog_gauges_update_on_poll(recorder):
+    wd = watchdog.Watchdog(timeout=60)    # never started: poll directly
+    with blackbox.in_flight("probe", {"n": 1}):
+        time.sleep(0.01)
+        wd.poll()
+        snap = telemetry.compact_snapshot()
+        assert snap.get("graft_watchdog_inflight") == 1
+        assert snap.get("graft_watchdog_oldest_inflight_seconds") > 0
+    wd.poll()
+    assert telemetry.compact_snapshot().get("graft_watchdog_inflight") == 0
+    assert wd.trips == 0
+
+
+# ---------------------------------------------------------------------------
+# dist straggler detection (2-proc harness; skips where the backend
+# cannot run multiprocess collectives, like the pre-existing dist tests
+# fail on such machines)
+# ---------------------------------------------------------------------------
+
+def _skew_worker():
+    from test_dist_multiprocess import _PRELUDE
+    return _PRELUDE + textwrap.dedent("""
+        try:
+            kv = mx.kv.create("dist_sync")
+            rank, nw = kv.rank, kv.num_workers
+            assert nw == 2, nw
+            kv.init("w", nd.zeros((16,)))
+            for step in range(3):
+                kv.push("w", nd.ones((16,)) * (rank + 1))
+            out = nd.zeros((16,))
+            kv.pull("w", out=out)
+            # no updater: the store holds the LAST reduced push (1+2)
+            assert np.allclose(out.asnumpy(), 3.0), out.asnumpy()
+
+            from incubator_mxnet_tpu import telemetry
+            from incubator_mxnet_tpu.telemetry import blackbox
+            snap = telemetry.compact_snapshot()
+            # one skew observation per reduce batch (init bcast is not one)
+            assert snap.get("graft_dist_worker_skew_seconds_count",
+                            0) >= 3, snap
+            beats = [e for e in blackbox.events()
+                     if e["kind"] == "dist_heartbeat"]
+            assert len(beats) >= 3, beats
+            assert beats[-1]["data"]["workers"] == 2
+            doc = blackbox.snapshot()
+            assert set(doc["workers"]) == {"0", "1"}, doc["workers"]
+            assert doc["workers"]["0"]["step"] >= 3
+            assert doc["workers"]["1"]["step"] >= 3
+            assert doc["rank"] == rank
+            print("WORKER %d SKEW OK" % rank, flush=True)
+        except Exception:
+            import traceback
+            tb = traceback.format_exc()
+            if "Multiprocess computations aren't implemented" in tb:
+                print("SKIP-MULTIPROC", flush=True)
+                os._exit(0)
+            raise
+    """)
+
+
+def test_two_process_worker_skew_histogram(tmp_path):
+    """Straggler detection: the per-step worker-skew histogram and the
+    flight recorder's per-worker last-seen table must populate from the
+    heartbeat piggybacked on the dist_sync reduce path."""
+    from test_dist_multiprocess import _launch_two
+    out = _launch_two(tmp_path, _skew_worker(), timeout=240,
+                      port_base=9900, require_rc0=False)
+    if "SKIP-MULTIPROC" in out:
+        pytest.skip("backend lacks multiprocess CPU collectives")
+    assert "WORKER 0 SKEW OK" in out and "WORKER 1 SKEW OK" in out, \
+        out[-3000:]
+
+
+# ---------------------------------------------------------------------------
+# review regressions: innermost-trip, SIG_IGN chaining, renderer edge
+# ---------------------------------------------------------------------------
+
+def test_watchdog_trips_innermost_expired_bracket(recorder):
+    """A stalled collective inside a step opens step -> collective; the
+    trip must name the INNERMOST stuck bracket, and the whole nest is
+    one incident (no second trip for the enclosing step)."""
+    wd = watchdog.Watchdog(timeout=0.05)
+    trips = []
+    wd.trip = lambda entry, age: trips.append((entry["site"], age))
+    with blackbox.in_flight("step", {"origin": "trainer"}):
+        time.sleep(0.02)
+        with blackbox.in_flight("collective", {"path": "reduce_many"}):
+            time.sleep(0.1)               # both brackets now expired
+            wd.poll()
+            assert [s for s, _ in trips] == ["collective"]
+            assert trips[0][1] > 0.05
+            wd.poll()                     # same incident: no re-trip
+            assert len(trips) == 1
+
+
+def test_signal_hooks_respect_sig_ign(tmp_path):
+    """A process that parked SIGTERM on SIG_IGN before import must keep
+    ignoring it — the chain may not turn an ignored signal fatal."""
+    script = tmp_path / "ign.py"
+    script.write_text(textwrap.dedent("""
+        import os, signal
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import incubator_mxnet_tpu as mx
+        os.kill(os.getpid(), signal.SIGTERM)   # must stay ignored
+        print("SURVIVED", flush=True)
+    """))
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    env = dict(os.environ, PYTHONPATH=repo + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run([sys.executable, str(script)], capture_output=True,
+                       text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "SURVIVED" in r.stdout
+
+
+def test_renderer_handles_error_phase_without_error(recorder):
+    """A step event whose phase failed but whose exception was caught
+    inside the journal has error_phase and no error key — the text
+    renderer must render it, not KeyError on the dump it explains."""
+    blackbox.record("step", origin="t", index=1, latency_ms=1.0,
+                    phases={"update": 0.001}, error_phase="update")
+    from incubator_mxnet_tpu.telemetry.__main__ import _render_blackbox_text
+    text = _render_blackbox_text(
+        blackbox.summarize_dump(blackbox.snapshot()))
+    assert "ERROR update" in text

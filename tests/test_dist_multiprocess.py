@@ -285,6 +285,16 @@ _DEAD_NODE_WORKER = _PRELUDE + textwrap.dedent("""
             break
         time.sleep(0.5)
     assert n == 1, n
+    # the timeout path is SURFACED, not a silent return (graftwatch):
+    # the gauge tracks the count and the flight recorder holds an event
+    # naming the dead worker
+    from incubator_mxnet_tpu import telemetry
+    from incubator_mxnet_tpu.telemetry import blackbox
+    assert telemetry.registry().gauge("graft_dist_dead_nodes").value() \
+        == 1
+    dead_evs = [e for e in blackbox.events() if e["kind"] == "dead_nodes"]
+    assert dead_evs, blackbox.events()
+    assert dead_evs[-1]["data"]["dead"] == [1], dead_evs[-1]
     print("WORKER 0 DEADNODE OK", flush=True)
     os._exit(0)   # skip jax.distributed teardown: rank 1 is gone
 """)
@@ -292,7 +302,9 @@ _DEAD_NODE_WORKER = _PRELUDE + textwrap.dedent("""
 
 def test_async_dead_node_detection(tmp_path):
     """Kill a worker mid-job: the parameter service's heartbeat table must
-    surface num_dead_nodes == 1 (kvstore_dist.h:109-115)."""
+    surface num_dead_nodes == 1 (kvstore_dist.h:109-115) — through the
+    graft_dist_dead_nodes gauge and a flight-recorder event, not just
+    the return value (asserted inside the worker)."""
     # the launcher reports nonzero when a worker vanishes mid-job (the
     # coordination service flags the lost member) — that's the scenario
     # under test, so only the rank-0 marker matters
@@ -300,3 +312,14 @@ def test_async_dead_node_detection(tmp_path):
                       port_base=9600, require_rc0=False)
     assert "WORKER 0 DEADNODE OK" in out, out[-3000:]
     assert "WORKER 1 DYING" in out, out[-3000:]
+
+
+def test_num_dead_nodes_surfaces_gauge_single_process():
+    """Single-process contract of the same surfacing: the sync wire
+    always answers 0, and the answer lands on the gauge (runnable
+    without multi-host collectives)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import telemetry
+    kv = mx.kv.create("dist_sync")
+    assert kv.num_dead_nodes() == 0
+    assert telemetry.registry().gauge("graft_dist_dead_nodes").value() == 0

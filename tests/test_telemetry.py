@@ -451,3 +451,120 @@ def test_cli_summary_json(capsys):
     assert report["kvstore_bytes"]["push_bytes"] > 0
     assert report["device_memory"]
     assert "graft_engine_flushes_total" in report["metrics"]
+
+
+# ---------------------------------------------------------------------------
+# graftwatch satellites: exception-safe spans + registry thread safety
+# ---------------------------------------------------------------------------
+
+def test_phase_span_closes_on_exception(tmp_path):
+    """A body that raises mid-phase must still land a (marked) phase
+    event and its latency observation — crash traces stay well-formed."""
+    before = telemetry.compact_snapshot().get(
+        'graft_phase_seconds_count{phase="fwd"}', 0)
+
+    def run():
+        with pytest.raises(ValueError):
+            with ttracing.phase_span("fwd"):
+                raise ValueError("mid-phase crash")
+
+    events = _traced(run, tmp_path)
+    spans = [e for e in events
+             if e.get("cat") == "phase" and e["name"] == "fwd"]
+    assert spans and spans[-1]["args"]["error"] is True
+    assert ttracing.validate_chrome_trace({"traceEvents": events}) == []
+    after = telemetry.compact_snapshot().get(
+        'graft_phase_seconds_count{phase="fwd"}', 0)
+    assert after == before + 1
+
+
+def test_op_span_closes_on_exception(tmp_path, monkeypatch):
+    """An eager op that raises at dispatch must still close its span
+    (previously the manual __enter__/__exit__ pair leaked the event)."""
+    from incubator_mxnet_tpu.ops.registry import get_op
+    op = get_op("abs")
+
+    def bad_bind(params, is_train):
+        raise RuntimeError("bind exploded")
+
+    monkeypatch.setattr(op, "bind", bad_bind)
+    a = mx.nd.ones((4, 4))
+
+    def run():
+        with pytest.raises(RuntimeError):
+            a.abs()
+
+    events = _traced(run, tmp_path)
+    spans = [e for e in events
+             if e.get("name") == "abs" and e.get("ph") == "X"]
+    assert spans and spans[-1]["args"]["error"] is True
+
+
+def test_segment_flush_span_closes_flows_on_error(tmp_path, monkeypatch):
+    """A replay that raises mid-flush must still emit the segment span
+    and finish every flow link — no dangling arrows in a crash trace."""
+    def bad_build(instrs, live):
+        def boom(ext):
+            raise ValueError("replay exploded")
+        return boom
+
+    monkeypatch.setattr(engine, "_build_replay", bad_build)
+    a = mx.nd.array(np.ones((7, 5), np.float32))   # unique: cache miss
+
+    def run():
+        with pytest.raises(ValueError):
+            with engine.bulk(8):
+                ((a * a) + a).asnumpy()
+
+    events = _traced(run, tmp_path)
+    assert ttracing.validate_chrome_trace({"traceEvents": events}) == []
+    spans = [e for e in events if e.get("name") == ttracing.SEGMENT_SPAN]
+    assert spans and spans[-1]["args"]["error"] is True
+    starts = [e for e in events if e.get("ph") == "s"]
+    finishes = [e for e in events if e.get("ph") == "f"]
+    assert len(starts) == 2 and len(finishes) == 2
+
+
+def test_metrics_mutation_vs_snapshot_thread_safety():
+    """The watchdog snapshots from a background thread while training
+    threads mutate: exports must be internally consistent (a histogram's
+    bucket counts, count and sum from ONE moment) and no increment may
+    be lost."""
+    import threading
+
+    reg = tmetrics.MetricsRegistry()
+    c = reg.counter("hammer_total", "x")
+    h = reg.histogram("hammer_hist", "x", buckets=(0.5, 1.5))
+    g = reg.gauge("hammer_gauge", "x", labelnames=("t",))
+    n_threads, n_iters = 8, 3000
+    errs = []
+
+    def worker(tid):
+        try:
+            for i in range(n_iters):
+                c.inc()
+                h.observe(1.0)
+                g.set(i, t=str(tid))
+        except Exception as exc:       # pragma: no cover - the failure
+            errs.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    # hammer snapshots concurrently: every exported histogram payload
+    # must satisfy the per-sample invariants (1.0 lands in the 1.5
+    # bucket, sum == count exactly for unit observations)
+    while any(t.is_alive() for t in threads):
+        for _labels, payload in h.samples():
+            assert payload["buckets"]["1.5"] == payload["count"]
+            assert payload["sum"] == pytest.approx(payload["count"] * 1.0)
+        reg.snapshot(collect=False)
+        reg.prometheus_text(collect=False)
+    for t in threads:
+        t.join()
+    assert not errs
+    assert c.value() == n_threads * n_iters
+    (_labels, payload), = h.samples()
+    assert payload["count"] == n_threads * n_iters
+    assert payload["sum"] == pytest.approx(n_threads * n_iters * 1.0)

@@ -12,6 +12,9 @@
 # 3. graftfuse smoke — bench_eager.py --smoke steps a many-small-param
 #    Trainer through the bucketed fused path and asserts bit-parity with
 #    the per-param path, so a fused-step regression fails this tier.
+# 4. graftwatch smoke — telemetry --blackbox --selftest exercises the
+#    flight recorder end-to-end (engine flushes, kvstore collectives, a
+#    step journal, an in-flight bracket) and validates the dump schema.
 #
 # Usage: tools/run_lint.sh [report.json]
 set -uo pipefail
@@ -21,5 +24,7 @@ REPORT="${1:-/tmp/graftlint_report.json}"
 python -m incubator_mxnet_tpu.analysis.graftlint --all --report "$REPORT" \
     || exit $?
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_eager.py --smoke \
+    || exit $?
+python -m incubator_mxnet_tpu.telemetry --blackbox --selftest \
     || exit $?
 exec python -m incubator_mxnet_tpu.telemetry --selftest
