@@ -25,8 +25,17 @@ stepped through ``gluon.Trainer`` on the per-param path (one optimizer
 kernel per parameter) vs the bucketed fused path (one multi-tensor
 dispatch per bucket) — the ratio lands in the BENCH JSON as
 ``fused_step_speedup`` and the two paths are asserted bit-identical.
-``--smoke`` runs ONLY a fast version of that section (small iteration
-counts) so the lint tier exercises the bucketed path end-to-end.
+``--smoke`` runs ONLY a fast version of that section plus the graftlap
+overlap section (small iteration counts) so the lint tier exercises the
+bucketed and overlapped paths end-to-end.
+
+Round 7 (graftlap) adds ``overlap_step_*``: the same 64-param model
+trained with a REAL backward pass through a dist_sync store, stepping
+with bucket reduces issued serially inside ``step()`` (the PR 4 path)
+vs issued mid-backward by the grad-ready hooks — only the ``step()``
+call is timed (the backward is identical either way), the two runs are
+asserted bit-identical, and the measured overlap ratio
+(``graft_trainer_overlap_ratio``) is reported.
 """
 import json
 import sys
@@ -91,6 +100,87 @@ def _fused_step_bench(iters=30, n_params=FUSED_N_PARAMS, shape=FUSED_SHAPE):
     }
 
 
+def _overlap_step_bench(iters=12, repeats=4, n_params=FUSED_N_PARAMS,
+                        shape=FUSED_SHAPE, bucket_bytes=1 << 20):
+    """Serial-bucketed vs overlapped Trainer.step over a many-small-param
+    model behind a (single-worker) dist_sync store — the reduce_many
+    wire the fused path rides.  Each iteration runs a real
+    record()/backward() so the grad-ready hooks fire; only the step()
+    call is timed (mean per round, min over interleaved rounds), because
+    graftlap's claim is that step() stops doing cold communication work,
+    not that backward gets faster.  Asserts bit-parity before reporting
+    and carries the measured overlap ratio from telemetry."""
+    import jax.numpy as jnp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, telemetry
+
+    def build(prefix, overlap):
+        rs = np.random.RandomState(0)
+        ps = []
+        for k in range(n_params):
+            p = gluon.Parameter("%s%d" % (prefix, k), shape=shape)
+            p.initialize(ctx=mx.cpu())
+            p.data()._write(jnp.asarray(rs.randn(*shape).astype(np.float32)))
+            ps.append(p)
+        t = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01},
+                          kvstore=mx.kv.create("dist_sync"))
+        t._bucket_bytes_override = bucket_bytes
+        t._overlap_override = overlap
+        return ps, t
+
+    rs = np.random.RandomState(1)
+    consts = [mx.nd.array(rs.randn(*shape).astype(np.float32))
+              for _ in range(n_params)]
+
+    def train_round(params, trainer, n, timed):
+        step_s = 0.0
+        for _ in range(n):
+            with autograd.record():
+                loss = None
+                for p, c in zip(params, consts):
+                    y = (p.data() * p.data() * c).sum()
+                    loss = y if loss is None else loss + y
+            loss.backward()
+            t0 = time.perf_counter()
+            trainer.step(1)
+            if timed:
+                step_s += time.perf_counter() - t0
+        params[-1].data().asnumpy()              # sync
+        return step_s / max(n, 1)
+
+    pa, ta = build("ovs", False)
+    pb, tb = build("ovo", True)
+    # warmup: compiles + plan build + (for B) the first serial step that
+    # arms the hooks — from here on B's backward issues every bucket
+    train_round(pa, ta, 2, timed=False)
+    train_round(pb, tb, 2, timed=False)
+    best = {False: float("inf"), True: float("inf")}
+    for _ in range(repeats):
+        best[False] = min(best[False], train_round(pa, ta, iters, True))
+        best[True] = min(best[True], train_round(pb, tb, iters, True))
+    parity = all(a.data().asnumpy().tobytes() == b.data().asnumpy().tobytes()
+                 for a, b in zip(pa, pb))
+    assert parity, "overlapped Trainer.step diverged from the serial " \
+        "bucketed path"
+    snap = telemetry.compact_snapshot()
+    return {
+        "overlap_step_params": n_params,
+        "overlap_step_buckets": int(snap.get(
+            "graft_trainer_bucket_count", 0)),
+        "overlap_step_serial_ms": round(best[False] * 1e3, 3),
+        "overlap_step_overlapped_ms": round(best[True] * 1e3, 3),
+        "overlap_step_latency_ratio": round(best[True] / best[False], 3),
+        "overlap_step_speedup": round(best[False] / best[True], 2),
+        "overlap_step_parity": parity,
+        "overlap_measured_ratio": round(float(snap.get(
+            "graft_trainer_overlap_ratio", 0.0)), 4),
+        "overlap_buckets_overlapped_total": snap.get(
+            'graft_trainer_overlap_buckets_total{mode="overlapped"}', 0),
+        "overlap_buckets_serial_total": snap.get(
+            'graft_trainer_overlap_buckets_total{mode="serial"}', 0),
+    }
+
+
 def _blackbox_overhead_bench(iters=ITERS, repeats=5):
     """Flight-recorder steady-state cost on the 64-op bulked dispatch
     chain: the same loop timed with the recorder ON (the default) vs
@@ -139,6 +229,7 @@ def smoke():
     bit-parity assert in a few seconds, print one JSON line."""
     import jax
     res = _fused_step_bench(iters=3)
+    res.update(_overlap_step_bench(iters=4, repeats=2))
     res.update(_blackbox_overhead_bench(iters=10, repeats=3))
     res["metric"] = "fused_step_smoke"
     res["backend"] = jax.default_backend()
@@ -285,11 +376,15 @@ def main():
     # -- graftfuse: bucketed Trainer.step vs per-param (round 4) ---------
     fused = _fused_step_bench(iters=ITERS)
 
+    # -- graftlap: overlapped vs serial bucketed step (round 7) ----------
+    overlap = _overlap_step_bench(iters=ITERS // 2)
+
     # -- graftwatch: flight-recorder overhead on the same 64-op chain ----
     blackbox_overhead = _blackbox_overhead_bench()
 
     print(json.dumps({
         **fused,
+        **overlap,
         **blackbox_overhead,
         "metric": "eager_small_op_dispatch",
         "backend": backend,

@@ -24,9 +24,28 @@ import jax.numpy as jnp
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
            "is_training", "set_recording", "set_training", "mark_variables",
-           "backward", "grad", "get_symbol", "Function"]
+           "backward", "grad", "get_symbol", "Function",
+           "backward_pass_id", "register_hook_source",
+           "unregister_hook_source"]
 
 _state = threading.local()
+
+# graftlap: consumers that installed _grad_ready_hook attrs register here
+# so a hook-less process never pays the per-backward finalization prescan
+# (an O(tape fan-in) getattr walk).  A WeakSet: a Trainer dropped without
+# disarming vanishes from the set on GC, re-gating the scan by itself.
+import weakref as _weakref
+_hook_sources = _weakref.WeakSet()
+
+
+def register_hook_source(source):
+    """Declare that ``source`` has grad-ready hooks installed somewhere
+    (gluon's _BucketScheduler).  Only the set's non-emptiness matters."""
+    _hook_sources.add(source)
+
+
+def unregister_hook_source(source):
+    _hook_sources.discard(source)
 
 
 def _st():
@@ -34,7 +53,19 @@ def _st():
         _state.recording = False
         _state.training = False
         _state.tape = []
+        _state.backward_passes = 0
     return _state
+
+
+def backward_pass_id():
+    """Monotonic id of the calling thread's latest backward pass.
+
+    graftlap consumers (the Trainer's bucket scheduler) use it to tell
+    gradients of the CURRENT pass from leftovers of an earlier one: a
+    grad-ready hook firing under a new pass id means every in-flight
+    reduce issued during the previous pass is stale and must be
+    discarded before scheduling restarts."""
+    return _st().backward_passes
 
 
 def is_recording():
@@ -131,6 +162,19 @@ def _run_backward(heads, head_grads, retain_graph, train_mode, variables=None,
     cotangents (so second derivatives see both dependencies), and
     cotangent accumulation goes through the recorded add op — the
     returned gradients are ordinary tape-connected NDArrays.
+
+    graftlap: arrays carrying a ``_grad_ready_hook`` attribute have their
+    gradient delivered *mid-walk*, the moment it is final — an input's
+    gradient can only change while nodes listing it as an input are
+    processed, so once the reverse walk passes the input's earliest tape
+    position the accumulated cotangent is the finished gradient.  The
+    hook fires right after delivery, which is what lets the Trainer's
+    bucket scheduler issue a bucket's allreduce while backward is still
+    producing earlier-layer gradients.  Hooks are suppressed whenever
+    the pass is not a plain full backward (``create_graph``, an explicit
+    ``variables`` list, or ``retain_graph`` — where a later pass may
+    legally re-write the delivered grads): consumers fall back to their
+    serial path.
     """
     # any bulk-deferred segment must land its tape node before the walk
     # (a recorded segment only becomes a node at flush)
@@ -138,6 +182,7 @@ def _run_backward(heads, head_grads, retain_graph, train_mode, variables=None,
     _engine.flush(cause="autograd")
 
     s = _st()
+    s.backward_passes += 1
     tape = list(s.tape)
     from ..telemetry import metrics as _tmetrics
     _tmetrics.autograd_backward(len(tape))
@@ -166,26 +211,55 @@ def _run_backward(heads, head_grads, retain_graph, train_mode, variables=None,
         else:
             grads[key] = grads[key] + g
 
-    for node in reversed(tape):
-        if not any(id(o) in grads for o in node.outputs):
-            continue
-        if node.used and not retain_graph:
-            raise RuntimeError(
-                "graph already backpropagated; use retain_graph=True "
-                "(parity: mxnet 'hit a node twice' check)")
-        out_cts = tuple(grads.get(id(o)) if id(o) in grads else _zero_ct(o)
-                        for o in node.outputs)
-        if create_graph:
-            in_cts = _recorded_vjp(node, out_cts)
-        else:
-            ct = out_cts[0] if len(out_cts) == 1 else out_cts
-            in_cts = node.vjp(ct)
-        for idx, (inp, g) in enumerate(zip(node.inputs, in_cts)):
-            if idx in node.op.nograd_inputs or g is None:
-                continue
-            _accum(id(inp), g)
-        if not retain_graph:
-            node.used = True
+    # graftlap finalization schedule: for every hooked grad-receiving
+    # input, the tape index of its EARLIEST appearance — once the reverse
+    # walk passes that index the accumulated cotangent is final.  Built
+    # only for the plain full-backward shape (see docstring); hooked
+    # arrays are delivered early, everything else keeps the end-of-walk
+    # delivery below, so semantics are unchanged for non-participants.
+    fire_hooks = variables is None and not create_graph \
+        and not retain_graph and bool(_hook_sources)
+    final_at = {}               # tape index -> [NDArray, ...]
+    if fire_hooks:
+        seen = set()
+        for k, node in enumerate(tape):
+            for idx, inp in enumerate(node.inputs):
+                if idx in node.op.nograd_inputs or id(inp) in seen:
+                    continue
+                if getattr(inp, "_grad_ready_hook", None) is not None \
+                        and inp._grad is not None \
+                        and inp._grad_req != "null":
+                    seen.add(id(inp))
+                    final_at.setdefault(k, []).append(inp)
+
+    for k in range(len(tape) - 1, -1, -1):
+        node = tape[k]
+        if any(id(o) in grads for o in node.outputs):
+            if node.used and not retain_graph:
+                raise RuntimeError(
+                    "graph already backpropagated; use retain_graph=True "
+                    "(parity: mxnet 'hit a node twice' check)")
+            out_cts = tuple(grads.get(id(o)) if id(o) in grads
+                            else _zero_ct(o) for o in node.outputs)
+            if create_graph:
+                in_cts = _recorded_vjp(node, out_cts)
+            else:
+                ct = out_cts[0] if len(out_cts) == 1 else out_cts
+                in_cts = node.vjp(ct)
+            for idx, (inp, g) in enumerate(zip(node.inputs, in_cts)):
+                if idx in node.op.nograd_inputs or g is None:
+                    continue
+                _accum(id(inp), g)
+            if not retain_graph:
+                node.used = True
+        for arr in final_at.pop(k, ()):
+            # final for this pass: deliver now and tell the scheduler —
+            # last-layer grads (high tape indices) fire first, giving the
+            # reverse-topological bucket order that lets their reduces
+            # overlap the rest of the walk
+            if id(arr) in grads:
+                _deliver(arr, grads, create_graph)
+                _fire_ready_hook(arr)
 
     results = None
     if variables is not None:
@@ -203,6 +277,22 @@ def _run_backward(heads, head_grads, retain_graph, train_mode, variables=None,
     if not retain_graph and not create_graph:
         s.tape = [n for n in s.tape if not n.used]
     return results
+
+
+def _fire_ready_hook(arr):
+    """Invoke one array's grad-ready hook; a broken hook must never take
+    the user's backward pass down with it (the scheduler side marks
+    itself broken and the Trainer falls back to the serial reduce)."""
+    hook = getattr(arr, "_grad_ready_hook", None)
+    if hook is None:
+        return
+    try:
+        hook(arr)
+    except Exception:
+        import logging
+        logging.getLogger("graftlap").exception(
+            "grad-ready hook raised; gradient delivery is unaffected "
+            "but overlapped reduces fall back to the serial path")
 
 
 def _deliver(arr, grads, as_ndarray=False):

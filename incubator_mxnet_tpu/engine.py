@@ -94,7 +94,7 @@ from .telemetry import blackbox as _blackbox
 from .telemetry import metrics as _tmetrics
 from .telemetry import tracing as _ttracing
 
-__all__ = ["bulk", "flush", "flush_stats", "reset_flush_stats",
+__all__ = ["bulk", "offband", "flush", "flush_stats", "reset_flush_stats",
            "EngineHazardError", "engine_check_enabled", "set_engine_check",
            "BoundedCache", "cache_sizes", "flatten_arrays", "unflatten",
            "split_flat"]
@@ -307,6 +307,29 @@ class bulk(object):
             flush(cause="scope-close")
         finally:
             _tls.state = self._prev
+
+
+class offband(object):
+    """Dispatch eagerly ALONGSIDE an open bulk segment without joining or
+    flushing it (graftlap).  A collective issued mid-backward — the
+    Trainer's bucket scheduler firing a gradient allreduce from a
+    grad-ready hook — must not become a deferred instruction of whatever
+    segment the caller happens to have open (the reduce has to hit the
+    wire NOW, that is the whole point), and it must not force that
+    segment to materialize either (the deferred ops are unrelated to the
+    gradients being reduced).  Inside this scope the bulk state is
+    stashed and ops dispatch through the ordinary eager path — XLA's
+    async dispatch keeps them concurrent with everything else — while
+    the surrounding segment's pending program survives untouched and
+    flushes at its own boundary."""
+
+    def __enter__(self):
+        self._prev = _current()
+        _tls.state = None
+        return self
+
+    def __exit__(self, *exc):
+        _tls.state = self._prev
 
 
 def maybe_defer(op, params, vals, is_train, kw, rec=False, nd_inputs=None,

@@ -58,10 +58,36 @@ class DataLoader(object):
                              "not be specified if batch_sampler is specified.")
         self._batch_sampler = batch_sampler
         self._num_workers = num_workers
+        self._pool = None       # lazily-created per-loader worker pool
         if batchify_fn is None:
             self._batchify_fn = default_batchify_fn
         else:
             self._batchify_fn = batchify_fn
+
+    def _worker_pool(self):
+        """The loader's thread pool, created on first use and REUSED
+        across epochs — tearing a pool down and respawning its threads
+        every ``__iter__`` (one per epoch) paid thread start-up latency
+        exactly when the next epoch's first batches were needed."""
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._num_workers,
+                thread_name_prefix="graft-dataloader")
+        return self._pool
+
+    def close(self):
+        """Shut the worker pool down (idempotent; a later ``__iter__``
+        lazily recreates it).  Do not call while an epoch iterator is
+        mid-flight — its next lookahead submit would raise."""
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass                # interpreter teardown: nothing to save
 
     def __iter__(self):
         if self._num_workers == 0:
@@ -69,12 +95,14 @@ class DataLoader(object):
                 yield self._batchify_fn([self._dataset[idx] for idx in batch])
             return
         # thread-pool pipeline with one-batch lookahead (double buffering)
-        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
-            def make(batch):
-                return self._batchify_fn([self._dataset[idx] for idx in batch])
-            futures = []
-            it = iter(self._batch_sampler)
-            depth = max(2, self._num_workers)
+        pool = self._worker_pool()
+
+        def make(batch):
+            return self._batchify_fn([self._dataset[idx] for idx in batch])
+        futures = []
+        it = iter(self._batch_sampler)
+        depth = max(2, self._num_workers)
+        try:
             try:
                 for _ in range(depth):
                     futures.append(pool.submit(make, next(it)))
@@ -87,6 +115,12 @@ class DataLoader(object):
                 except StopIteration:
                     pass
                 yield out
+        finally:
+            # abandoned epoch (break / exception in the consumer): the
+            # pool now outlives the iterator, so queued lookahead work
+            # must not linger into the next epoch
+            for f in futures:
+                f.cancel()
 
     def __len__(self):
         return len(self._batch_sampler)

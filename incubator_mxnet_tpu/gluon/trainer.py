@@ -26,6 +26,8 @@ grad-accumulation API — keeps exact per-key write-back semantics).
 from __future__ import annotations
 
 import os
+import time
+import weakref
 
 import numpy as np
 
@@ -48,6 +50,227 @@ class _Bucket(object):
         self.kind = kind
         self.dtype = dtype
         self.nbytes = nbytes
+
+
+class _BucketScheduler(object):
+    """graftlap: issue each bucket's gradient allreduce DURING backward.
+
+    Armed by ``Trainer.step`` with the current fused plan, the scheduler
+    hangs a grad-ready hook on every eligible parameter's data arrays
+    (autograd fires it the moment that parameter's gradient is final —
+    see ``autograd._run_backward``).  When the last (param, context) pair
+    of a bucket reports ready, the bucket's concatenated flat gradient is
+    built with the EXACT serial-path math (``Trainer._bucket_flat``) and
+    shipped through ``KVStore.reduce_many_async`` — an in-flight handle
+    with its own flight-recorder bracket — while backward keeps producing
+    earlier-layer gradients.  ``Trainer.step`` then only *waits* on the
+    handles.  Because the hook order is the reverse-topological walk of a
+    tape every rank shares (SPMD), the issue order of the collectives is
+    identical on every worker: the lockstep contract holds.
+
+    Safety rails (each one degrades to the serial PR-4 reduce, never to
+    wrong values):
+
+    * hooks fire only on a plain full backward — ``retain_graph``,
+      ``create_graph`` and explicit-variables passes suppress them;
+    * a hook under a NEW ``autograd.backward_pass_id()`` abandons every
+      handle of the previous pass before scheduling restarts (a second
+      backward overwrote the reduced grads);
+    * only buckets whose params all have ``grad_req == "write"`` are
+      eligible ("add" accumulation means grads are not final per pass);
+    * at consume time every grad's ``_version`` must still match its
+      issue-time stamp (gradient clipping or any other post-backward
+      mutation invalidates the handle);
+    * a scheduler exception marks it broken for the step instead of
+      propagating into the user's backward.
+    """
+
+    __slots__ = ("_trainer_ref", "_armed", "_waiting", "_hooked",
+                 "_buckets", "_pass_id", "_broken", "_plan", "_hook",
+                 "issued_total", "taken_total", "__weakref__")
+
+    def __init__(self, trainer):
+        self._trainer_ref = weakref.ref(trainer)
+        # ONE hook closure, created once (`self._on_ready` builds a fresh
+        # bound method per attribute access, so ad-hoc accessors would
+        # never pass disarm's identity check and hooks would leak), and
+        # holding the scheduler WEAKLY: a bound method would pin the
+        # scheduler — and through nothing else, the arrays its hooks sit
+        # on — alive long after the Trainer is dropped, keeping the
+        # autograd hook-source gate open forever.  With the weakref the
+        # scheduler dies with its Trainer; orphaned hook attrs left on
+        # param arrays degrade to a dead-ref no-op until overwritten.
+        sched_ref = weakref.ref(self)
+
+        def _hook(arr, _ref=sched_ref):
+            sched = _ref()
+            if sched is not None:
+                sched._on_ready(arr)
+        self._hook = _hook
+        self._armed = False
+        self._waiting = {}      # id(data NDArray) -> (bucket state, i, j)
+        self._hooked = []       # data NDArrays carrying our hook
+        self._buckets = {}      # id(bucket) -> state dict
+        self._pass_id = None
+        self._broken = False
+        self._plan = None       # the armed plan, held STRONGLY: identity
+        #                         (same cached tuple) means same plan, and
+        #                         the ref pins it so a recycled id() can
+        #                         never alias a new plan
+        self.issued_total = 0   # buckets issued mid-backward (ever)
+        self.taken_total = 0    # issued buckets actually consumed by step
+
+    # -- arming -------------------------------------------------------------
+    def arm(self, plan):
+        """Install hooks for ``plan``'s eligible buckets (called at the
+        end of every overlapped step, so the NEXT backward schedules).
+        Steady state — same (cached) plan object, scheduler healthy —
+        skips the reinstall: the next backward's first hook resets the
+        pending sets via the pass-id rollover, so re-arming is O(1)."""
+        if self._armed and not self._broken and self._plan is plan:
+            self._abandon_all()
+            for state in self._buckets.values():
+                state["handle"] = None
+                state["flat"] = None
+            self._pass_id = None    # next hook rebuilds pending sets
+            return
+        self.disarm()
+        trainer = self._trainer_ref()
+        if trainer is None:
+            return
+        buckets, _leftover = plan
+        for b in buckets:
+            if any(trainer._params[i].grad_req != "write"
+                   for i in b.indices):
+                continue        # "add" accumulation: never final per pass
+            state = {"bucket": b, "pending": set(), "handle": None,
+                     "flat": None, "versions": None, "grads": []}
+            for i in b.indices:
+                grads = trainer._params[i].list_grad()
+                for j, d in enumerate(trainer._params[i].list_data()):
+                    state["pending"].add((i, j))
+                    state["grads"].append(grads[j])
+                    self._waiting[id(d)] = (state, i, j)
+                    d._grad_ready_hook = self._hook
+                    self._hooked.append(d)
+            if state["pending"]:
+                self._buckets[id(b)] = state
+        self._armed = bool(self._buckets)
+        if self._armed:
+            from .. import autograd
+            autograd.register_hook_source(self)
+        self._plan = plan if self._armed else None
+        self._pass_id = None
+        self._broken = False
+
+    def disarm(self):
+        """Drop hooks and abandon anything still in flight."""
+        for d in self._hooked:
+            if getattr(d, "_grad_ready_hook", None) is self._hook:
+                d._grad_ready_hook = None
+        self._hooked = []
+        self._waiting = {}
+        self._abandon_all()
+        self._buckets = {}
+        self._armed = False
+        self._plan = None
+        from .. import autograd
+        autograd.unregister_hook_source(self)
+
+    def _abandon_all(self):
+        for state in self._buckets.values():
+            if state["handle"] is not None:
+                state["handle"].abandon()
+                state["handle"] = None
+
+    # -- the hook (fires inside autograd._run_backward) ---------------------
+    def _on_ready(self, arr):
+        if not self._armed or self._broken:
+            return
+        if self._trainer_ref() is None:
+            # the Trainer is gone but something still holds the scheduler
+            # (a kept `t._scheduler` ref): clean up after ourselves
+            self.disarm()
+            return
+        try:
+            from .. import autograd
+            pass_id = autograd.backward_pass_id()
+            if pass_id != self._pass_id:
+                # new backward pass: everything issued for the previous
+                # one reduces grads that were just overwritten — discard
+                # and start this pass clean
+                n_ctx = self._ctx_count()
+                self._abandon_all()
+                for state in self._buckets.values():
+                    state["pending"] = {(i, j)
+                                        for i in state["bucket"].indices
+                                        for j in range(n_ctx)}
+                self._pass_id = pass_id
+            entry = self._waiting.get(id(arr))
+            if entry is None:
+                return
+            state, i, j = entry
+            state["pending"].discard((i, j))
+            if not state["pending"] and state["handle"] is None:
+                self._issue(state)
+        except Exception:
+            self._broken = True
+            self._abandon_all()
+            raise               # _fire_ready_hook catches + logs; the
+            #                     user's backward pass is unaffected
+
+    def _ctx_count(self):
+        trainer = self._trainer_ref()
+        return len(trainer._contexts) if trainer is not None else 0
+
+    def _issue(self, state):
+        """All grads of one bucket are final: build the flat buffer and
+        put its reduce on the wire, without joining (or flushing) any
+        bulk segment the surrounding code has open."""
+        trainer = self._trainer_ref()
+        if trainer is None:
+            return
+        kv = trainer._kvstore_obj
+        if kv is None:
+            return
+        b = state["bucket"]
+        with _engine.offband():
+            flat = trainer._bucket_flat(b)
+            state["versions"] = [g._version for g in state["grads"]]
+            state["flat"] = flat
+            state["handle"] = kv.reduce_many_async(
+                [flat], label="bucket[%s:%dp:%dB]" % (
+                    np.dtype(b.dtype).name, len(b.indices), b.nbytes))
+        self.issued_total += 1
+
+    # -- consuming (Trainer.step) -------------------------------------------
+    def take(self, plan):
+        """Hand the step the buckets whose reduces are validly in flight:
+        ``{id(bucket): (flat NDArray, ReduceHandle)}``.  Stale handles
+        (grad versions moved since issue) are abandoned; everything is
+        one-shot — the caller re-arms for the next step."""
+        trainer = self._trainer_ref()
+        out = {}
+        if trainer is None or not self._armed or self._broken:
+            self._abandon_all()
+            return out
+        buckets, _leftover = plan
+        by_id = {id(b): b for b in buckets}
+        for bid, state in self._buckets.items():
+            handle = state["handle"]
+            if handle is None:
+                continue
+            b = by_id.get(bid)
+            if b is None:
+                handle.abandon()        # plan changed under us
+                continue
+            if [g._version for g in state["grads"]] != state["versions"]:
+                handle.abandon()        # stale grads: serial fallback
+                continue
+            out[bid] = (state["flat"], handle)
+            state["handle"] = None      # consumed
+        self.taken_total += len(out)
+        return out
 
 
 class Trainer(object):
@@ -75,6 +298,7 @@ class Trainer(object):
         self._init_optimizer(optimizer, optimizer_params)
         self._kv_initialized = False
         self._kvstore = kvstore
+        self._scheduler = _BucketScheduler(self)
 
     def _check_contexts(self):
         contexts = None
@@ -168,10 +392,14 @@ class Trainer(object):
         # graftwatch step journal: one flight-recorder event per step
         # with kvstore/update phase latencies + device-memory highwater;
         # a crash or hang mid-step names the phase it stopped in
+        overlap = plan is not None and self._overlap_enabled() \
+            and not self._update_on_kvstore and self._kvstore_obj is not None
         with _blackbox.step_journal("trainer", batch_size=batch_size,
-                                    fused=plan is not None):
+                                    fused=plan is not None,
+                                    overlapped=overlap):
             with _ttracing.phase_span("kvstore"):
                 if plan is None:
+                    self._scheduler.disarm()
                     self._allreduce_grads()
                 else:
                     reduced = self._bucketed_allreduce(plan)
@@ -180,11 +408,23 @@ class Trainer(object):
                     self._update(ignore_stale_grad)
                 else:
                     self._bucketed_update(plan, reduced)
+        # graftlap: (re-)arm the grad-ready hooks so the NEXT backward
+        # issues each bucket's reduce the moment its grads finalize;
+        # first step after any config change runs serial (the plan must
+        # exist before hooks know the buckets)
+        if overlap:
+            self._scheduler.arm(plan)
+        elif self._scheduler._armed:
+            self._scheduler.disarm()
 
     def allreduce_grads(self):
         """ref: trainer.py allreduce_grads (1.3+, for grad accumulation)."""
         if not self._kv_initialized:
             self._init_kvstore()
+        # the accumulation API reduces INTO param.grad() with write-back
+        # semantics; anything graftlap issued against the same grads is
+        # unrelated to this call — drop it so no bracket stays open
+        self._scheduler.disarm()
         self._allreduce_grads()
 
     def _allreduce_grads(self):
@@ -226,6 +466,7 @@ class Trainer(object):
 
     # -- graftfuse: the bucketed step path ---------------------------------
     _bucket_bytes_override = None     # tests/benches force a target here
+    _overlap_override = None          # tests/benches force overlap on/off
 
     def _bucket_target_bytes(self):
         if self._bucket_bytes_override is not None:
@@ -235,6 +476,16 @@ class Trainer(object):
                                       str(_DEFAULT_BUCKET_BYTES)))
         except ValueError:
             return _DEFAULT_BUCKET_BYTES
+
+    def _overlap_enabled(self):
+        """GRAFT_OVERLAP (default on): overlap bucket reduces with the
+        backward pass (graftlap).  Like GRAFT_BLACKBOX, multi-host jobs
+        must set it IDENTICALLY on every rank — the issue order of the
+        overlapped collectives is part of the lockstep contract."""
+        if self._overlap_override is not None:
+            return bool(self._overlap_override)
+        return os.environ.get("GRAFT_OVERLAP", "1").strip().lower() \
+            not in ("0", "false", "no", "off")
 
     def _fused_plan(self):
         """The bucket plan for the current configuration, or None when
@@ -303,14 +554,37 @@ class Trainer(object):
                                       len(leftover))
         return plan
 
+    def _bucket_flat(self, b):
+        """One bucket's concatenated local gradient: per-context flatten
+        (one jitted dispatch each) + elementwise context tree-sum in
+        context order — THE packing math, shared verbatim by the serial
+        step path and the overlapped mid-backward issue so the two are
+        bit-identical by construction."""
+        from ..ndarray import NDArray
+        per_ctx = [
+            _engine.flatten_arrays(tuple(
+                self._params[i].list_grad()[j]._read()
+                for i in b.indices))
+            for j in range(len(self._contexts))]
+        acc = per_ctx[0]
+        for f in per_ctx[1:]:
+            acc = acc + f
+        return NDArray(acc, ctx=self._contexts[0])
+
     def _bucketed_allreduce(self, plan):
         """Reduce every bucket's gradients with ONE concatenated buffer
         per bucket: contexts tree-sum elementwise (the same addition
         order as KVStore._reduce), workers allreduce through
         ``KVStore.reduce_many`` in one fused collective.  Returns
         {id(bucket): flat reduced NDArray}; empty when there is no store
-        (the fused update then reads the per-param grads directly)."""
-        from ..ndarray import NDArray
+        (the fused update then reads the per-param grads directly).
+
+        graftlap: buckets whose reduce the scheduler already put on the
+        wire mid-backward are only WAITED on here (same buffer, same
+        reduction, earlier issue time); buckets that missed the overlap
+        window — first step, stale grads, hook fallback — take the
+        serial reduce exactly as before.  Wait order is plan order on
+        every rank."""
         buckets, leftover = plan
         kv = self._kvstore_obj
         if kv is not None and leftover:
@@ -319,19 +593,39 @@ class Trainer(object):
             kv.pull_many(leftover, grads)
         if kv is None:
             return {}
-        flats = []
+        overlap = self._overlap_enabled() and not self._update_on_kvstore
+        issued = self._scheduler.take(plan) if overlap else {}
+        serial = [b for b in buckets if id(b) not in issued]
+        flats = {id(b): self._bucket_flat(b) for b in serial}
+        if serial:
+            kv.reduce_many([flats[id(b)] for b in serial])
+        reduced, exposed_s, inflight_s = {}, 0.0, 0.0
         for b in buckets:
-            per_ctx = [
-                _engine.flatten_arrays(tuple(
-                    self._params[i].list_grad()[j]._read()
-                    for i in b.indices))
-                for j in range(len(self._contexts))]
-            acc = per_ctx[0]
-            for f in per_ctx[1:]:
-                acc = acc + f
-            flats.append(NDArray(acc, ctx=self._contexts[0]))
-        kv.reduce_many(flats)
-        return {id(b): nd for b, nd in zip(buckets, flats)}
+            entry = issued.get(id(b))
+            if entry is None:
+                reduced[id(b)] = flats[id(b)]
+                continue
+            flat, handle = entry
+            t0 = time.perf_counter()
+            handle.wait()
+            t1 = time.perf_counter()
+            exposed_s += t1 - t0
+            inflight_s += t1 - handle.issued_at
+            reduced[id(b)] = flat
+        if overlap:
+            if issued:
+                # a fully-overlapped step reduces only through
+                # reduce_many_async, which skips the piggybacked dist
+                # heartbeat (it would serialize the async dispatch) —
+                # keep the worker-skew/last-seen telemetry alive with
+                # one heartbeat from the wait side.  `issued` is
+                # SPMD-symmetric, so every rank takes this collective
+                # together (lockstep contract)
+                kv.heartbeat()
+            from ..telemetry import metrics as _tmetrics
+            _tmetrics.trainer_overlap(len(issued), len(serial),
+                                      exposed_s, inflight_s)
+        return reduced
 
     def _bucketed_update(self, plan, reduced):
         """One fused multi-tensor optimizer dispatch per (bucket,

@@ -21,6 +21,8 @@ TPU-native rebirth of src/kvstore/ + python/mxnet/kvstore.py:
 from __future__ import annotations
 
 import pickle
+import sys
+import time
 
 import numpy as np
 import jax.numpy as jnp
@@ -50,7 +52,72 @@ def _wire_bytes(nbytes, compressor):
         return nbytes
     return max(nbytes // 16, 1)
 
-__all__ = ["KVStore", "create", "create_kvstore"]
+__all__ = ["KVStore", "ReduceHandle", "create", "create_kvstore"]
+
+
+class ReduceHandle(object):
+    """One asynchronously issued bucket reduce (graftlap).
+
+    Returned by :meth:`KVStore.reduce_many_async`: the collective is
+    already ON THE WIRE (XLA dispatches asynchronously), ``values`` hold
+    the in-flight results, and :meth:`wait` blocks until they are ready.
+    Between issue and wait the handle keeps an open flight-recorder
+    bracket (``collective`` site, ``path="reduce_many_async"`` with the
+    bucket label), so a reduce that never lands is named by the watchdog
+    and shows up in crash dumps as the stuck in-flight bucket.
+
+    ``issued_at`` is the issue-time ``perf_counter()`` stamp — the
+    Trainer derives the overlap ratio (fraction of in-flight wall time
+    hidden under backward) from it."""
+
+    __slots__ = ("values", "label", "issued_at", "_bracket", "_done")
+
+    def __init__(self, values, label=None, _bracket=None):
+        self.values = list(values)
+        self.label = label
+        self.issued_at = time.perf_counter()
+        self._bracket = _bracket
+        self._done = False
+
+    @property
+    def done(self):
+        return self._done
+
+    def _close(self):
+        if self._bracket is not None:
+            bracket, self._bracket = self._bracket, None
+            bracket.__exit__(None, None, None)
+
+    def _begin_wait(self):
+        """Flip the flight-recorder bracket from "deliberately left in
+        flight" to "being waited on": re-stamp its clock and drop the
+        ``async_pending`` flag so the watchdog starts aging it.  Before
+        this, a long gap between issue and wait (a big backward, user
+        code between backward and step) is healthy overlap, not a hang —
+        the watchdog must not trip on it."""
+        entry = getattr(self._bracket, "entry", None)
+        if entry is not None and entry.pop("async_pending", None):
+            entry["since"] = time.time()
+
+    def wait(self):
+        """Block until the reduced values are ready; returns them.
+        Idempotent — later calls are free."""
+        if not self._done:
+            self._done = True
+            self._begin_wait()
+            try:
+                import jax
+                jax.block_until_ready([v._read() for v in self.values])
+            finally:
+                self._close()
+        return self.values
+
+    def abandon(self):
+        """Drop the handle without consuming the result (the Trainer's
+        stale-grad fallback).  The dispatched work completes on its own;
+        only the bracket closes and the values are never read."""
+        self._done = True
+        self._close()
 
 
 def _key_str(key):
@@ -149,9 +216,14 @@ class KVStore(object):
                 # kvstore_local.h PushImpl assigns local = merged)
                 self._store[k]._write(red._read().astype(self._store[k].dtype))
 
-    def _cross_worker_reduce_many(self, reds):
+    def _cross_worker_reduce_many(self, reds, heartbeat=True):
         """Single-process store: nothing to do (dist overrides with one
-        fused collective over all values; mutates them in place)."""
+        fused collective over all values; mutates them in place).
+        ``heartbeat=False`` marks async issues: the dist path skips its
+        piggybacked worker-heartbeat allreduce there, because reading the
+        heartbeat result host-side would serialize against the bucket
+        collective just dispatched — exactly the wait graftlap exists to
+        avoid."""
         return reds
 
     def push_many(self, keys, values, priority=0):
@@ -184,6 +256,52 @@ class KVStore(object):
         with _blackbox.collective("reduce_many", n_keys=len(values),
                                   nbytes=raw):
             return self._cross_worker_reduce_many(list(values))
+
+    def reduce_many_async(self, values, label=None):
+        """Issue the cross-worker reduce of ``values`` WITHOUT waiting
+        and return a :class:`ReduceHandle` (graftlap).  The collective is
+        dispatched immediately — on the dist wire that is the in-graph
+        XLA all-reduce, which executes asynchronously — so the caller
+        (the Trainer's bucket scheduler, firing from a grad-ready hook
+        mid-backward) keeps computing while the bytes move.  The handle's
+        ``wait()`` is the only synchronization point; until then the
+        reduce is an open flight-recorder bracket carrying ``label``, so
+        the watchdog and crash dumps can name a stuck bucket.  Byte
+        accounting and reduction algebra are EXACTLY ``reduce_many``'s
+        (same per-value elementwise worker sum), only the wait moves."""
+        values = list(values)
+        if not values:
+            return ReduceHandle(values, label=label)
+        raw = sum(_nd_bytes(v) for v in values)
+        _tmetrics.kvstore_push(raw, raw)
+        _tmetrics.kvstore_pull(raw)
+        bracket = _blackbox.collective(
+            "reduce_many_async", n_keys=len(values), nbytes=raw,
+            bucket=label)
+        bracket.__enter__()
+        entry = getattr(bracket, "entry", None)
+        if entry is not None:
+            # watchdog contract: an async bracket ages only from the
+            # moment someone blocks on it (ReduceHandle._begin_wait) —
+            # its open time before that measures healthy overlap
+            entry["async_pending"] = True
+        try:
+            self._cross_worker_reduce_many(values, heartbeat=False)
+        except BaseException:
+            bracket.__exit__(*sys.exc_info())
+            raise
+        return ReduceHandle(values, label=label, _bracket=bracket)
+
+    def heartbeat(self):
+        """Run one dist worker heartbeat outside a reduce batch.  The
+        heartbeat normally piggybacks on ``_cross_worker_reduce_many``,
+        but a fully-overlapped step (graftlap) reduces exclusively
+        through ``reduce_many_async`` — which must skip it (the host-side
+        read would serialize the async dispatch) — so the Trainer calls
+        this once from the wait side instead, keeping the worker-skew
+        histogram and the crash-dump last-seen table live.  Single-process
+        stores have no peers: no-op (dist overrides)."""
+        return None
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast store value into out list (ref: KVStore::Pull)."""

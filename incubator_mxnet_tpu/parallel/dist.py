@@ -383,7 +383,7 @@ class DistKVStore(KVStore):
             np.concatenate(ptr_parts)))
         return red
 
-    def _cross_worker_reduce_many(self, reds):
+    def _cross_worker_reduce_many(self, reds, heartbeat=True):
         """All values of one push in as few collectives as possible:
         same-dtype values pack into one flat buffer (native dtype, so
         integer sums stay exact) and go through ONE in-graph all-reduce —
@@ -433,10 +433,23 @@ class DistKVStore(KVStore):
         # one tiny extra allreduce per reduce BATCH (not per key) carries
         # every worker's arrival timestamp + step counter.  Gated on the
         # recorder switch, which therefore must be set CONSISTENTLY
-        # across ranks (collective-lockstep contract) — see docs.
-        if _blackbox.enabled():
+        # across ranks (collective-lockstep contract) — see docs.  Async
+        # issues (graftlap, heartbeat=False) skip it: reading the
+        # heartbeat table host-side blocks on everything dispatched
+        # before it on the same devices, which would turn the async
+        # issue into a synchronous reduce.  Every rank derives
+        # ``heartbeat`` from the same code path, so the collective
+        # sequence stays in lockstep.
+        if heartbeat and _blackbox.enabled():
             self._heartbeat_skew()
         return reds
+
+    def heartbeat(self):
+        """One worker heartbeat on demand (the Trainer's overlapped-step
+        wait side): same gating as the reduce-batch piggyback — recorder
+        on (rank-consistent, lockstep contract) and real peers."""
+        if num_workers() > 1 and _blackbox.enabled():
+            self._heartbeat_skew()
 
     def _heartbeat_skew(self):
         """Per-worker step heartbeat: each rank contributes its arrival

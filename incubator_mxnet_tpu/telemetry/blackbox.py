@@ -214,6 +214,11 @@ def in_flight(site, detail=None):
 _ewma_lock = threading.Lock()
 _ewma = {}                      # path -> EWMA seconds
 _EWMA_FLOOR = 1e-3              # ignore sub-ms noise for straggler calls
+# async brackets stay open from issue until the consumer waits, so their
+# "latency" measures how long the result was LEFT in flight (graftlap:
+# mostly the rest of the backward pass), not wire health — feeding that
+# into the straggler EWMA would cry wolf on every well-overlapped step
+_NO_STRAGGLER_PATHS = frozenset(["reduce_many_async"])
 
 
 def _straggler_factor():
@@ -254,6 +259,8 @@ class _Collective(object):
         """Slow-collective detection: a call beyond ``factor`` × its own
         EWMA (per path) earns a log line + a ring event.  The EWMA only
         updates on healthy calls so one straggler can't poison it."""
+        if self.path in _NO_STRAGGLER_PATHS:
+            return
         factor = _straggler_factor()
         with _ewma_lock:
             prev = _ewma.get(self.path)

@@ -547,6 +547,35 @@ def trainer_buckets(bucket_bytes_list, n_leftover):
         h.observe(nb)
 
 
+def trainer_overlap(n_overlapped, n_serial, exposed_s, inflight_s):
+    """One overlapped ``Trainer.step`` (graftlap): how much of the bucket
+    reduces' in-flight wall time was hidden under backward.
+
+    ``exposed_s`` is the time step() actually spent blocked in
+    ``ReduceHandle.wait``; ``inflight_s`` is the summed issue-to-ready
+    wall time of the overlapped handles.  The ratio gauge is
+    ``1 - exposed/inflight`` — 1.0 means every overlapped reduce landed
+    before step() looked at it, 0.0 means nothing was hidden (the serial
+    cost in a different place)."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    c = r.counter("graft_trainer_overlap_buckets_total",
+                  "Bucket reduces by issue mode (overlapped = put on the "
+                  "wire mid-backward; serial = reduced inside step())",
+                  ("mode",))
+    c.inc(n_overlapped, mode="overlapped")
+    c.inc(n_serial, mode="serial")
+    r.histogram("graft_trainer_overlap_exposed_seconds",
+                "Per-step reduce wait time NOT hidden under backward", (),
+                buckets=_PHASE_BUCKETS).observe(exposed_s)
+    if inflight_s > 0:
+        r.gauge("graft_trainer_overlap_ratio",
+                "Fraction of overlapped-reduce in-flight wall time hidden "
+                "under the backward pass (last overlapped step)").set(
+            max(0.0, min(1.0, 1.0 - exposed_s / inflight_s)))
+
+
 def trainer_fused_update(n_params):
     """One fused multi-tensor optimizer dispatch (per bucket, per
     context); latency lands on the existing ``update`` phase span."""

@@ -88,9 +88,13 @@ class Watchdog(threading.Thread):
         oldest_age = max((now - e["since"] for e in entries), default=0.0)
         progress_age = now - _blackbox.last_progress()["ts"]
         _metrics.watchdog_status(len(entries), oldest_age, progress_age)
+        # async_pending brackets (graftlap reduces issued mid-backward)
+        # are deliberately left open until their consumer waits — they
+        # age only from _begin_wait's re-stamp, never from issue time
         expired = [e for e in entries
                    if now - e["since"] > self.timeout
-                   and not e.get("tripped")]
+                   and not e.get("tripped")
+                   and not e.get("async_pending")]
         if expired:
             target = max(expired, key=lambda e: e["since"])   # innermost
             for e in expired:

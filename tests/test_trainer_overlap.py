@@ -1,0 +1,519 @@
+"""graftlap: the overlapped bucketed reduce must be BIT-IDENTICAL to the
+PR 4 serial bucketed path.
+
+The overlap path moves each bucket's allreduce ISSUE into the backward
+pass (autograd grad-ready hooks -> Trainer._BucketScheduler ->
+KVStore.reduce_many_async) while keeping the bucket contents, the
+packing math (Trainer._bucket_flat, shared verbatim) and the per-bucket
+reduction order exactly the serial path's — so the parity contract is
+bytes-equality on weights AND optimizer states, not allclose.  Also
+here: the hook fallbacks (retain_graph, stale grads, GRAFT_OVERLAP=0),
+the engine offband guarantee (an async issue must not flush an open
+bulk segment), the watchdog naming a stuck in-flight bucket, the
+2-process dist_sync parity harness, and the DataLoader worker-pool
+hoist satellite.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, engine, gluon
+from incubator_mxnet_tpu.telemetry import blackbox, watchdog
+import jax.numpy as jnp
+
+
+SPECS = [(7,), (3, 5), (11,), (2, 2, 2), (13,), (4,)]
+
+
+def _make_params(prefix, specs=SPECS, dtype="float32", grad_reqs=None):
+    params = []
+    for k, shape in enumerate(specs):
+        req = grad_reqs[k] if grad_reqs else "write"
+        p = gluon.Parameter("%s%d" % (prefix, k), shape=shape, dtype=dtype,
+                            grad_req=req)
+        p.initialize(ctx=mx.cpu())
+        params.append(p)
+    return params
+
+
+def _seed(params, weights):
+    for p, w in zip(params, weights):
+        p.data()._write(jnp.asarray(w).astype(p.data().dtype))
+
+
+def _state_leaves(state):
+    if state is None:
+        return []
+    if isinstance(state, (tuple, list)):
+        out = []
+        for s in state:
+            out.extend(_state_leaves(s))
+        return out
+    return [state]
+
+
+def _assert_bit_identical(params_a, params_b, trainer_a, trainer_b):
+    for a, b in zip(params_a, params_b):
+        wa, wb = a.data().asnumpy(), b.data().asnumpy()
+        assert wa.dtype == wb.dtype
+        assert wa.tobytes() == wb.tobytes(), \
+            "weight %s diverged (max |d|=%g)" % (
+                a.name, float(np.max(np.abs(
+                    wa.astype(np.float64) - wb.astype(np.float64)))))
+    sa, sb = trainer_a._updaters[0].states, trainer_b._updaters[0].states
+    assert set(sa) == set(sb)
+    for i in sa:
+        for x, y in zip(_state_leaves(sa[i]), _state_leaves(sb[i])):
+            assert x.asnumpy().tobytes() == y.asnumpy().tobytes(), \
+                "state %d diverged" % i
+
+
+def _backward_loss(params, consts):
+    """One real recorded forward + backward over every trainable param
+    (grads depend on the weights, so they evolve across steps) — this is
+    what fires the grad-ready hooks."""
+    with autograd.record():
+        loss = None
+        for p, c in zip(params, consts):
+            if p.grad_req == "null":
+                continue
+            y = (p.data() * p.data() * c).sum()
+            loss = y if loss is None else loss + y
+    loss.backward()
+
+
+def _build_trainer(params, optimizer, opt_kw, overlap, bucket_bytes=48):
+    t = gluon.Trainer(params, optimizer, dict(opt_kw),
+                      kvstore=mx.kv.create("dist_sync"))
+    t._bucket_bytes_override = bucket_bytes
+    t._overlap_override = overlap
+    return t
+
+
+def _parity_run(optimizer, opt_kw, specs=SPECS, dtype="float32",
+                grad_reqs=None, bucket_bytes=48, steps=5, batch_size=2):
+    rs = np.random.RandomState(7)
+    weights = [rs.randn(*s).astype(np.float32) for s in specs]
+    consts = [mx.nd.array(rs.randn(*s).astype(np.float32)) for s in specs]
+    pa = _make_params("s", specs, dtype, grad_reqs)
+    pb = _make_params("o", specs, dtype, grad_reqs)
+    _seed(pa, weights)
+    _seed(pb, weights)
+    ta = _build_trainer(pa, optimizer, opt_kw, False, bucket_bytes)
+    tb = _build_trainer(pb, optimizer, opt_kw, True, bucket_bytes)
+    for _ in range(steps):
+        _backward_loss(pa, consts)
+        ta.step(batch_size)
+        _backward_loss(pb, consts)
+        tb.step(batch_size)
+    assert tb._fused_plan() is not None, \
+        "overlapped trainer unexpectedly fell off the bucketed path"
+    # the first step arms the hooks, so steps 2..N must actually overlap
+    assert ta._scheduler.issued_total == 0
+    assert tb._scheduler.issued_total > 0, "overlap never engaged"
+    assert tb._scheduler.taken_total > 0, \
+        "issued reduces were never consumed by step()"
+    _assert_bit_identical(pa, pb, ta, tb)
+    return ta, tb
+
+
+def test_sgd_parity_with_null_holes():
+    _parity_run("sgd", {"learning_rate": 0.1, "wd": 0.01},
+                grad_reqs=["write", "null", "write", "write", "null",
+                           "write"])
+
+
+def test_sgd_momentum_parity_small_buckets():
+    _parity_run("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.01},
+                bucket_bytes=48)
+
+
+def test_sgd_momentum_multi_precision_bf16_parity():
+    _parity_run("sgd", {"learning_rate": 0.05, "momentum": 0.9,
+                        "wd": 0.001, "multi_precision": True},
+                dtype="bfloat16", bucket_bytes=24, steps=6)
+
+
+def test_adam_parity():
+    _parity_run("adam", {"learning_rate": 0.01},
+                grad_reqs=["write", "null", "write", "write", "write",
+                           "write"], steps=5)
+
+
+def test_single_bucket_parity():
+    _parity_run("sgd", {"learning_rate": 0.1, "momentum": 0.9},
+                bucket_bytes=1 << 20)
+
+
+def test_reduce_issued_during_backward():
+    """The core graftlap semantic: after backward returns (and BEFORE
+    step), every bucket's reduce is already in flight as a ReduceHandle
+    with an open flight-recorder bracket naming the bucket."""
+    rs = np.random.RandomState(3)
+    params = _make_params("inflight")
+    _seed(params, [rs.randn(*s).astype(np.float32) for s in SPECS])
+    consts = [mx.nd.array(rs.randn(*s).astype(np.float32)) for s in SPECS]
+    t = _build_trainer(params, "sgd", {"learning_rate": 0.1}, True)
+    _backward_loss(params, consts)
+    t.step(2)                       # serial; arms the hooks
+    _backward_loss(params, consts)
+    handles = [s["handle"] for s in t._scheduler._buckets.values()]
+    assert handles and all(h is not None for h in handles), \
+        "no reduces in flight after backward"
+    sites = [e for e in blackbox.inflight_entries()
+             if e["detail"].get("path") == "reduce_many_async"]
+    if blackbox.enabled():
+        assert sites, "in-flight reduce carries no recorder bracket"
+        assert all("bucket[" in str(e["detail"].get("bucket"))
+                   for e in sites)
+    t.step(2)                       # consumes them
+    assert not [e for e in blackbox.inflight_entries()
+                if e["detail"].get("path") == "reduce_many_async"]
+    assert t._scheduler.taken_total >= len(handles)
+
+
+def test_hook_fallback_retain_graph():
+    """retain_graph=True suppresses the grad-ready hooks (a later pass
+    may re-write delivered grads), so the step must take the serial
+    reduce — and still match a serial trainer bit-for-bit."""
+    rs = np.random.RandomState(5)
+    weights = [rs.randn(*s).astype(np.float32) for s in SPECS]
+    consts = [mx.nd.array(rs.randn(*s).astype(np.float32)) for s in SPECS]
+    pa = _make_params("rga")
+    pb = _make_params("rgb")
+    _seed(pa, weights)
+    _seed(pb, weights)
+    ta = _build_trainer(pa, "sgd", {"learning_rate": 0.1}, False)
+    tb = _build_trainer(pb, "sgd", {"learning_rate": 0.1}, True)
+
+    def retain_step(params, trainer):
+        with autograd.record():
+            loss = None
+            for p, c in zip(params, consts):
+                y = (p.data() * p.data() * c).sum()
+                loss = y if loss is None else loss + y
+        loss.backward(retain_graph=True)
+        trainer.step(2)
+
+    retain_step(pa, ta)         # step 1 also arms tb's hooks
+    retain_step(pb, tb)
+    retain_step(pa, ta)
+    retain_step(pb, tb)
+    assert tb._scheduler.issued_total == 0, \
+        "hooks fired under retain_graph"
+    assert tb._scheduler.taken_total == 0
+    _assert_bit_identical(pa, pb, ta, tb)
+
+
+def test_stale_grads_fall_back_to_serial():
+    """Mutating a gradient between backward and step (gradient clipping,
+    manual edits) must invalidate the in-flight reduce — the step falls
+    back to the serial path and consumes the CURRENT grads."""
+    rs = np.random.RandomState(9)
+    weights = [rs.randn(*s).astype(np.float32) for s in SPECS]
+    consts = [mx.nd.array(rs.randn(*s).astype(np.float32)) for s in SPECS]
+    pa = _make_params("sta")
+    pb = _make_params("stb")
+    _seed(pa, weights)
+    _seed(pb, weights)
+    ta = _build_trainer(pa, "sgd", {"learning_rate": 0.1}, False)
+    tb = _build_trainer(pb, "sgd", {"learning_rate": 0.1}, True)
+
+    def clipped_step(params, trainer):
+        _backward_loss(params, consts)
+        for p in params:        # post-backward mutation: halve every grad
+            g = p.grad()
+            g._write(g._read() * 0.5)
+        trainer.step(2)
+
+    clipped_step(pa, ta)
+    clipped_step(pb, tb)        # arms
+    taken_before = tb._scheduler.taken_total
+    clipped_step(pa, ta)
+    clipped_step(pb, tb)        # issued mid-backward, then invalidated
+    assert tb._scheduler.issued_total > 0, "hooks never issued"
+    assert tb._scheduler.taken_total == taken_before, \
+        "stale in-flight reduce was consumed"
+    _assert_bit_identical(pa, pb, ta, tb)
+
+
+def test_graft_overlap_env_disables(monkeypatch):
+    monkeypatch.setenv("GRAFT_OVERLAP", "0")
+    rs = np.random.RandomState(2)
+    params = _make_params("env")
+    _seed(params, [rs.randn(*s).astype(np.float32) for s in SPECS])
+    consts = [mx.nd.array(rs.randn(*s).astype(np.float32)) for s in SPECS]
+    t = gluon.Trainer(params, "sgd", {"learning_rate": 0.1},
+                      kvstore=mx.kv.create("dist_sync"))
+    t._bucket_bytes_override = 48
+    for _ in range(3):
+        _backward_loss(params, consts)
+        t.step(2)
+    assert not t._scheduler._armed
+    assert t._scheduler.issued_total == 0
+
+
+def test_dropped_trainer_scheduler_is_collectable():
+    """A Trainer dropped without disarm must not be pinned by its hooks:
+    the hook closure holds the scheduler weakly, so the scheduler dies
+    with the Trainer, the autograd hook-source gate re-closes, and later
+    backwards over the same params degrade the leftover hook attrs to
+    no-ops."""
+    import gc
+    import weakref as _weakref
+    from incubator_mxnet_tpu import autograd as _ag
+    rs = np.random.RandomState(6)
+    params = _make_params("gc")
+    _seed(params, [rs.randn(*s).astype(np.float32) for s in SPECS])
+    consts = [mx.nd.array(rs.randn(*s).astype(np.float32)) for s in SPECS]
+    t = _build_trainer(params, "sgd", {"learning_rate": 0.1}, True)
+    for _ in range(2):
+        _backward_loss(params, consts)
+        t.step(2)
+    assert t._scheduler._armed
+    sched_ref = _weakref.ref(t._scheduler)
+    assert any(s is t._scheduler for s in _ag._hook_sources)
+    del t
+    gc.collect()
+    assert sched_ref() is None, "hooks kept the dropped scheduler alive"
+    gc.collect()
+    assert not list(_ag._hook_sources), "hook-source gate did not re-close"
+    # leftover hook attrs are dead-ref no-ops: backward still works
+    _backward_loss(params, consts)
+    for p in params:
+        assert p.grad().asnumpy() is not None
+
+
+def test_grad_accumulation_add_req_not_scheduled():
+    """grad_req='add' params accumulate across passes — their grads are
+    never final per-backward, so their buckets must not arm."""
+    rs = np.random.RandomState(4)
+    params = _make_params("acc", grad_reqs=["add"] * len(SPECS))
+    _seed(params, [rs.randn(*s).astype(np.float32) for s in SPECS])
+    consts = [mx.nd.array(rs.randn(*s).astype(np.float32)) for s in SPECS]
+    t = _build_trainer(params, "sgd", {"learning_rate": 0.1}, True)
+    for _ in range(3):
+        for p in params:
+            p.zero_grad()
+        _backward_loss(params, consts)
+        t.step(2)
+    assert t._scheduler.issued_total == 0
+
+
+# ---------------------------------------------------------------------------
+# engine: async issue must not flush the surrounding bulk segment
+# ---------------------------------------------------------------------------
+
+def test_async_reduce_does_not_flush_open_bulk_segment():
+    kv = mx.kv.create("dist_sync")
+    vals = [mx.nd.array(np.arange(8, dtype=np.float32))]
+    engine.reset_flush_stats()
+    with engine.bulk(64):
+        a = mx.nd.ones((4, 4))
+        b = a + 1.0             # deferred
+        c = b * 2.0             # deferred
+        h = kv.reduce_many_async(vals, label="bucket[offband]")
+        h.wait()
+        stats = engine.flush_stats()
+        assert sum(stats["causes"].values()) == 0, \
+            "async reduce flushed the open segment: %s" % stats
+        assert np.allclose(c.asnumpy(), 4.0)    # segment intact + correct
+    assert np.allclose(vals[0].asnumpy(), np.arange(8))
+
+
+def test_engine_offband_scope():
+    engine.reset_flush_stats()
+    with engine.bulk(64):
+        a = mx.nd.ones((2, 2))
+        b = a + 1.0             # deferred
+        with engine.offband():
+            # eager dispatch alongside: no join, no flush
+            c = mx.nd.ones((2, 2)) * 3.0
+            assert np.allclose(c.asnumpy(), 3.0)
+        assert sum(engine.flush_stats()["causes"].values()) == 0
+        assert np.allclose(b.asnumpy(), 2.0)
+
+
+# ---------------------------------------------------------------------------
+# watchdog: a stalled in-flight bucket is named
+# ---------------------------------------------------------------------------
+
+def test_watchdog_names_stalled_inflight_bucket():
+    prev = blackbox._enabled_override
+    blackbox.set_enabled(True)
+    try:
+        kv = mx.kv.create("dist_sync")
+        vals = [mx.nd.array(np.ones(16, np.float32))]
+        h = kv.reduce_many_async(vals, label="bucket[float32:4p:64B]")
+        wd = watchdog.Watchdog(timeout=0.05)
+        trips = []
+        wd.trip = lambda entry, age: trips.append(entry)
+        time.sleep(0.12)
+        # a bucket deliberately left in flight (backward still running /
+        # user code before step) is healthy overlap — NO trip, however
+        # old the bracket is...
+        wd.poll()
+        assert not trips, "watchdog tripped on a healthy in-flight bucket"
+        # ...but the dump names it while in flight
+        doc = blackbox.snapshot(reason="test")
+        stuck = [e for e in doc["in_flight"]
+                 if e["detail"].get("path") == "reduce_many_async"]
+        assert stuck and stuck[0]["detail"]["bucket"] \
+            == "bucket[float32:4p:64B]", doc["in_flight"]
+        # once the consumer starts WAITING, the clock re-stamps and a
+        # stall is a genuine hang: the trip names the bucket
+        h._begin_wait()
+        time.sleep(0.12)
+        wd.poll()
+        assert trips, "watchdog did not trip on the stalled bucket wait"
+        assert trips[0]["site"] == "collective"
+        assert trips[0]["detail"]["bucket"] == "bucket[float32:4p:64B]"
+        h.wait()
+        assert not [e for e in blackbox.inflight_entries()
+                    if e["detail"].get("path") == "reduce_many_async"]
+    finally:
+        blackbox.set_enabled(prev)
+
+
+def test_reduce_handle_wait_idempotent_and_abandon():
+    kv = mx.kv.create("local")
+    vals = [mx.nd.array(np.arange(4, dtype=np.float32))]
+    h = kv.reduce_many_async(vals, label="bucket[x]")
+    assert h.wait() is h.values and h.done
+    h.wait()                    # idempotent
+    h2 = kv.reduce_many_async(vals, label="bucket[y]")
+    h2.abandon()
+    assert h2.done
+    assert not [e for e in blackbox.inflight_entries()
+                if e["detail"].get("path") == "reduce_many_async"]
+
+
+# ---------------------------------------------------------------------------
+# satellite: DataLoader worker pool is per-loader, not per-epoch
+# ---------------------------------------------------------------------------
+
+def test_dataloader_pool_reused_across_epochs_and_closed():
+    data = gluon.data.ArrayDataset(
+        mx.nd.array(np.arange(24, dtype=np.float32).reshape(12, 2)))
+    dl = gluon.data.DataLoader(data, batch_size=4, num_workers=2)
+    first = [b.asnumpy() for b in dl]
+    pool = dl._pool
+    assert pool is not None, "worker pool was not created"
+    second = [b.asnumpy() for b in dl]
+    assert dl._pool is pool, "pool was recreated between epochs"
+    for a, b in zip(first, second):
+        assert np.array_equal(a, b)
+    dl.close()
+    assert dl._pool is None
+    assert pool._shutdown
+    # a later epoch lazily recreates
+    third = [b.asnumpy() for b in dl]
+    assert len(third) == len(first) and dl._pool is not None
+    dl.close()
+
+
+# ---------------------------------------------------------------------------
+# 2-process dist_sync: overlapped == serial across a REAL wire
+# ---------------------------------------------------------------------------
+
+def _overlap_worker():
+    from test_dist_multiprocess import _skipwrap
+    return _skipwrap("""
+        from incubator_mxnet_tpu import autograd, gluon
+        import jax.numpy as jnp
+
+        SPECS = [(7,), (3, 5), (11,), (2, 2, 2)]
+        rs = np.random.RandomState(7)
+        weights = [rs.randn(*s).astype(np.float32) for s in SPECS]
+        base = [rs.randn(*s).astype(np.float32) for s in SPECS]
+
+        kv_probe = mx.kv.create("dist_sync")
+        rank, nw = kv_probe.rank, kv_probe.num_workers
+        assert nw == 2, nw
+        # rank-dependent data: the allreduce has real cross-worker work
+        consts = [mx.nd.array(c * (rank + 1)) for c in base]
+
+        def build(prefix, overlap):
+            ps = []
+            for k, s in enumerate(SPECS):
+                p = gluon.Parameter("%s%d" % (prefix, k), shape=s)
+                p.initialize(ctx=mx.cpu())
+                p.data()._write(jnp.asarray(weights[k]))
+                ps.append(p)
+            t = gluon.Trainer(ps, "sgd",
+                              {"learning_rate": 0.05, "momentum": 0.9},
+                              kvstore=mx.kv.create("dist_sync"))
+            t._bucket_bytes_override = 48
+            t._overlap_override = overlap
+            return ps, t
+
+        def train(ps, t):
+            for _ in range(4):
+                with autograd.record():
+                    loss = None
+                    for p, c in zip(ps, consts):
+                        y = (p.data() * p.data() * c).sum()
+                        loss = y if loss is None else loss + y
+                loss.backward()
+                t.step(2)
+
+        pa, ta = build("s", False)
+        train(pa, ta)
+        pb, tb = build("o", True)
+        train(pb, tb)
+        assert tb._scheduler.issued_total > 0, "overlap never engaged"
+        assert tb._scheduler.taken_total > 0
+        # fully-overlapped steps must still feed the dist heartbeat
+        # (kv.heartbeat() from the wait side — worker-skew telemetry
+        # would otherwise starve once reduces go async)
+        from incubator_mxnet_tpu import telemetry
+        snap = telemetry.compact_snapshot()
+        assert snap.get("graft_dist_worker_skew_seconds_count", 0) \\
+            >= 3, snap
+        for a, b in zip(pa, pb):
+            assert a.data().asnumpy().tobytes() \\
+                == b.data().asnumpy().tobytes(), "diverged"
+        sa = ta._updaters[0].states
+        sb = tb._updaters[0].states
+        for i in sa:
+            assert sa[i].asnumpy().tobytes() \\
+                == sb[i].asnumpy().tobytes(), "state %d diverged" % i
+        # both ranks ended bit-identical to each other too
+        from jax.experimental import multihost_utils
+        both = multihost_utils.process_allgather(
+            jnp.asarray(pb[0].data().asnumpy()))
+        assert np.array_equal(np.asarray(both[0]), np.asarray(both[1]))
+        print("WORKER %d OVERLAP PARITY OK" % rank, flush=True)
+    """)
+
+
+def test_two_process_overlap_parity(tmp_path):
+    from test_dist_multiprocess import _launch_two
+    out = _launch_two(tmp_path, _overlap_worker(), timeout=300,
+                      port_base=9950, require_rc0=False)
+    assert "WORKER 0 OVERLAP PARITY OK" in out \
+        and "WORKER 1 OVERLAP PARITY OK" in out, out[-3000:]
+
+
+# ---------------------------------------------------------------------------
+# telemetry: the overlap gauge/histogram populate
+# ---------------------------------------------------------------------------
+
+def test_overlap_metrics_emitted():
+    from incubator_mxnet_tpu import telemetry
+    rs = np.random.RandomState(11)
+    params = _make_params("met")
+    _seed(params, [rs.randn(*s).astype(np.float32) for s in SPECS])
+    consts = [mx.nd.array(rs.randn(*s).astype(np.float32)) for s in SPECS]
+    t = _build_trainer(params, "sgd", {"learning_rate": 0.1}, True)
+    for _ in range(3):
+        _backward_loss(params, consts)
+        t.step(2)
+    snap = telemetry.compact_snapshot()
+    assert snap.get(
+        'graft_trainer_overlap_buckets_total{mode="overlapped"}', 0) > 0
+    assert "graft_trainer_overlap_ratio" in snap
+    assert 0.0 <= snap["graft_trainer_overlap_ratio"] <= 1.0
+    assert snap.get("graft_trainer_overlap_exposed_seconds_count", 0) >= 1
