@@ -310,18 +310,34 @@ class bulk(object):
 
 
 class offband(object):
-    """Dispatch eagerly ALONGSIDE an open bulk segment without joining or
-    flushing it (graftlap).  A collective issued mid-backward — the
-    Trainer's bucket scheduler firing a gradient allreduce from a
-    grad-ready hook — must not become a deferred instruction of whatever
-    segment the caller happens to have open (the reduce has to hit the
-    wire NOW, that is the whole point), and it must not force that
-    segment to materialize either (the deferred ops are unrelated to the
-    gradients being reduced).  Inside this scope the bulk state is
+    """PUBLIC API — dispatch eagerly ALONGSIDE an open bulk segment
+    without joining or flushing it.
+
+    Introduced for graftlap (the Trainer's bucket scheduler firing a
+    gradient allreduce from a grad-ready hook mid-backward): work issued
+    inside the scope must not become a deferred instruction of whatever
+    segment the caller happens to have open (it has to hit the wire
+    NOW), and it must not force that segment to materialize either (the
+    deferred ops are unrelated).  Inside this scope the bulk state is
     stashed and ops dispatch through the ordinary eager path — XLA's
     async dispatch keeps them concurrent with everything else — while
     the surrounding segment's pending program survives untouched and
-    flushes at its own boundary."""
+    flushes at its own boundary.
+
+    Now documented for user code (ROADMAP "engine offband for user
+    code"): any *dispatch now, alongside the open segment* need fits —
+    async checkpointing, metric pushes, ad-hoc collectives::
+
+        with mx.engine.bulk(64):
+            body()                       # defers into one segment
+            with mx.engine.offband():
+                checkpoint_shard.copy()  # dispatches immediately
+            more_body()                  # same segment keeps recording
+
+    Values produced inside the scope are ordinary concrete NDArrays;
+    values from the surrounding segment remain deferred and reading one
+    inside the scope still materializes its segment (same rule as any
+    read).  See docs/observability.md "Off-band dispatch"."""
 
     def __enter__(self):
         self._prev = _current()

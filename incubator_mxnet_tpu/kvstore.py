@@ -32,6 +32,7 @@ from .ndarray import NDArray
 from .ndarray import ndarray as _nd
 from . import optimizer as opt
 from .telemetry import blackbox as _blackbox
+from .telemetry import lens as _lens
 from .telemetry import metrics as _tmetrics
 
 
@@ -101,14 +102,25 @@ class ReduceHandle(object):
 
     def wait(self):
         """Block until the reduced values are ready; returns them.
-        Idempotent — later calls are free."""
+        Idempotent — later calls are free.  graftlens books the blocked
+        span as exposed communication and the issue→wait-return span as
+        in-flight communication — an upper bound on the reduce time
+        graftlap hid under backward (a handle whose wait queues behind
+        earlier handles books their wait time too, the same convention
+        as ``graft_trainer_overlap_ratio``)."""
         if not self._done:
             self._done = True
             self._begin_wait()
+            t0 = time.perf_counter()
             try:
                 import jax
                 jax.block_until_ready([v._read() for v in self.values])
             finally:
+                t1 = time.perf_counter()
+                if self.values:
+                    # an empty handle never hit the wire: booking its
+                    # issue->wait gap would fake hidden communication
+                    _lens.comm(t0, t1, inflight=t1 - self.issued_at)
                 self._close()
         return self.values
 

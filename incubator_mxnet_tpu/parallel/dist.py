@@ -473,6 +473,11 @@ class DistKVStore(KVStore):
         skew = float(ts_ms.max() - ts_ms.min()) / 1e3
         _tmetrics.dist_worker_skew(skew)
         base = max(int(ts_ms.max()), now_ms)
+        # this rank's lag behind the freshest arrival: an upper-bound
+        # clock-offset estimate stamped into dump headers so the trace
+        # aggregator can align a LONE dump (matched heartbeat/collective
+        # anchors are preferred when several ranks' artifacts are given)
+        _blackbox.set_clock_offset(float(base - ts_ms[rank()]) / 1e3)
         _blackbox.workers_seen(
             {r: {"lag_s": round(float(base - ts_ms[r]) / 1e3, 6),
                  "step": int(steps[r])} for r in range(W)},

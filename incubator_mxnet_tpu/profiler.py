@@ -182,13 +182,27 @@ def want_sync():
 def dump(finished=True):
     """Write the chrome://tracing JSON (ref: Profiler::DumpProfile,
     profiler.h:304; python profiler.py dump:105).  Open the file at
-    chrome://tracing or https://ui.perfetto.dev."""
+    chrome://tracing or https://ui.perfetto.dev.
+
+    Every dump leads with process/thread ``M`` metadata (rank-labeled
+    track) and carries an ``otherData.wall_anchor`` mapping the
+    profiler's monotonic clock to wall time — the identity + alignment
+    data ``telemetry --analyze`` needs to merge N ranks' traces onto one
+    timeline."""
     with _lock:
         events = list(_P.events)
         if finished:
             _P.events = []
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    try:
+        from .telemetry import tracing as _ttracing
+        meta, other = _ttracing.trace_header()
+        doc["traceEvents"] = meta + events
+        doc["otherData"] = other
+    except Exception:
+        pass                    # a dump must never fail on metadata glue
     with open(_P.filename, "w") as f:
-        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        json.dump(doc, f)
     return _P.filename
 
 

@@ -20,11 +20,23 @@ Four quarters (see docs/observability.md for the full guide):
   thread that trips when an engine flush / dist collective / phase stays
   in flight past ``GRAFT_WATCHDOG_TIMEOUT``, writing the dump + thread
   stacks (and aborting under ``GRAFT_WATCHDOG_ABORT``).
+* :mod:`~incubator_mxnet_tpu.telemetry.lens` — graftlens per-step
+  wall-time attribution (data_wait/forward/backward_compute/
+  exposed_comm/optimizer_update/host_gap, conserving the step wall
+  clock), kept in a ring of the last ``GRAFT_LENS_RING`` steps and
+  printable every ``GRAFT_STEP_REPORT`` steps.
+* :mod:`~incubator_mxnet_tpu.telemetry.aggregate` — cross-rank trace
+  merging: N per-rank chrome traces / blackbox dumps → ONE merged trace
+  with per-rank tracks, cross-rank flow links per collective, and a
+  straggler table (last-to-enter/exit rank + spreads).
 
 CLI::
 
     python -m incubator_mxnet_tpu.telemetry --summary [--json]
     python -m incubator_mxnet_tpu.telemetry --blackbox PATH [--json]
+    python -m incubator_mxnet_tpu.telemetry --steps [--json]
+    python -m incubator_mxnet_tpu.telemetry --analyze R0.json R1.json \
+        [--json | --merged OUT.json]
 
 Environment: ``GRAFT_TELEMETRY=0`` disables metric collection;
 ``GRAFT_TELEMETRY_SNAPSHOT=<path>`` writes the JSON snapshot at process
@@ -37,18 +49,20 @@ from __future__ import annotations
 import os as _os
 
 from . import metrics
+from . import lens
 from . import tracing
 from . import blackbox
 from . import watchdog
+from . import aggregate
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       compact_snapshot, enabled, parse_prometheus_text,
                       registry, set_enabled, write_snapshot)
 from .tracing import phase_span
 
-__all__ = ["metrics", "tracing", "blackbox", "watchdog", "Counter",
-           "Gauge", "Histogram", "MetricsRegistry", "registry", "enabled",
-           "set_enabled", "parse_prometheus_text", "compact_snapshot",
-           "write_snapshot", "phase_span"]
+__all__ = ["metrics", "lens", "tracing", "blackbox", "watchdog",
+           "aggregate", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "registry", "enabled", "set_enabled", "parse_prometheus_text",
+           "compact_snapshot", "write_snapshot", "phase_span"]
 
 _snapshot_path = _os.environ.get("GRAFT_TELEMETRY_SNAPSHOT")
 if _snapshot_path:

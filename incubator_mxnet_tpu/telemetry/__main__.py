@@ -1,10 +1,31 @@
-"""graftscope + graftwatch CLI.
+"""graftscope + graftwatch + graftlens CLI.
 
     python -m incubator_mxnet_tpu.telemetry --summary [--json]
         Run one bulked training step (gluon Trainer on CPU, a kvstore
         attached) with segment tracing on, then render the top-k segment
         flushes by device time and the metrics snapshot (flush causes,
         kvstore bytes, device-memory gauges) FROM THAT RUN.
+
+    python -m incubator_mxnet_tpu.telemetry --steps [--json]
+        graftlens live-ring demo: run a short gluon training loop (io
+        iterator -> record/backward -> Trainer.step on a kvstore) and
+        render the per-step wall-time attribution ring — each step's
+        data_wait/forward/backward/exposed_comm/update/host_gap
+        breakdown plus the mean fractions.
+
+    python -m incubator_mxnet_tpu.telemetry --analyze R0.json R1.json...
+        [--json | --merged OUT.json]
+        Cross-rank analysis: merge N per-rank chrome traces and/or
+        flight-recorder dumps into one clock-aligned trace (per-rank
+        process tracks, cross-rank flow links per collective) and print
+        the straggler table (last-to-enter/exit rank, enter/exit
+        spreads, per-rank blame counts).  --merged writes the merged
+        chrome trace; exits 1 on schema problems.
+
+    python -m incubator_mxnet_tpu.telemetry --analyze --selftest
+        Lint smoke tier for the aggregator: two synthetic rank dumps
+        (rank 1 deliberately delayed) must merge into a schema-valid
+        trace whose straggler table blames rank 1.
 
     python -m incubator_mxnet_tpu.telemetry --summary --trace T.json
         Same report over an existing chrome-trace dump (segment table
@@ -274,6 +295,152 @@ def _render_blackbox_text(report):
     return "\n".join(lines)
 
 
+def _demo_lens_steps(n_steps=6):
+    """A short real training loop with every lens source lit: io
+    iterator (data_wait), record scope (forward), backward, a local
+    kvstore (exposed_comm) and the fused update — fills the lens ring."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, io
+    from incubator_mxnet_tpu.telemetry import lens
+
+    prev = lens._enabled_override
+    lens.set_enabled(True)      # the demo must work under GRAFT_LENS=0
+    try:
+        lens.reset()
+        net = gluon.nn.Dense(8)
+        net.initialize()
+        rs = np.random.RandomState(0)
+        x = rs.rand(4 * n_steps, 16).astype(np.float32)
+        y = np.zeros((4 * n_steps, 8), np.float32)
+        net(mx.nd.array(x[:4])).asnumpy()      # param init outside
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1},
+                                kvstore=mx.kv.create("local"))
+        it = io.NDArrayIter(data=x, label=y, batch_size=4)
+        for batch in it:
+            data = batch.data[0]
+            with autograd.record():
+                out = net(data)
+                loss = (out * out).mean()
+            loss.backward()
+            trainer.step(batch_size=data.shape[0])
+            loss.asnumpy()
+        return lens.steps()
+    finally:
+        lens.set_enabled(prev)
+
+
+def _render_lens_text(records, agg):
+    from incubator_mxnet_tpu.telemetry.lens import ABBREV, COMPONENTS
+    short = dict(ABBREV)
+    lines = ["graftlens step attribution (%d steps in ring)"
+             % len(records), "=" * 72]
+    lines.append("%-5s %-8s %9s  %s" % (
+        "step", "origin", "wall(ms)",
+        " ".join("%7s" % short[c] for c in COMPONENTS)))
+    for r in records:
+        lines.append("%-5d %-8s %9.2f  %s" % (
+            r["step"], r["origin"], r["wall_s"] * 1e3,
+            " ".join("%7.2f" % (r["components"][c] * 1e3)
+                     for c in COMPONENTS)))
+    if agg.get("steps"):
+        fr = agg["fractions"]
+        lines.append("")
+        lines.append("mean %.2fms/step | %s" % (
+            agg["mean_step_ms"],
+            " ".join("%s %d%%" % (short[c], round(fr[c] * 100))
+                     for c in COMPONENTS)))
+        lines.append("comm blocked %.2fms / in-flight %.2fms over the ring"
+                     % (agg["comm_blocked_s"] * 1e3,
+                        agg["comm_inflight_s"] * 1e3))
+    return "\n".join(lines)
+
+
+def run_steps(as_json):
+    from incubator_mxnet_tpu.telemetry import lens
+    records = _demo_lens_steps()
+    agg = lens.summary(records)
+    if as_json:
+        print(json.dumps({"steps": records, "summary": agg}, indent=2,
+                         sort_keys=True, default=str))
+    else:
+        print(_render_lens_text(records, agg))
+    return 0 if records else 1
+
+
+def _render_analyze_text(report):
+    lines = ["graftlens cross-rank analysis", "=" * 72]
+    for r in sorted(report["ranks"], key=int):
+        info = report["ranks"][r]
+        lines.append("rank %-3s %-40s collectives %-5d heartbeats %d"
+                     % (r, ", ".join(info["sources"]),
+                        info["collectives"], info["heartbeats"]))
+    lines.append("clock offsets vs first rank (s): %s"
+                 % json.dumps(report["clock_offsets_s"]))
+    lines.append("merged trace: %d events, %d cross-rank flow links%s"
+                 % (report["merged_events"],
+                    report["cross_rank_flow_links"],
+                    ", written to %s" % report["merged_path"]
+                    if "merged_path" in report else ""))
+    rows = sorted(report["stragglers"],
+                  key=lambda r: -r["enter_spread_s"])[:10]
+    if rows:
+        lines.append("")
+        lines.append("straggler table (top %d by enter spread):" % len(rows))
+        lines.append("%-6s %-28s %-6s %-10s %-9s %14s %14s"
+                     % ("step", "collective", "ranks", "last-enter",
+                        "last-exit", "enter-sprd(ms)", "exit-sprd(ms)"))
+        for r in rows:
+            # async reduces carry no wire-synchronized exit (host-local
+            # wait-return): their exit columns render as "-"
+            exit_rank = "-" if r["last_to_exit"] is None \
+                else r["last_to_exit"]
+            exit_sprd = "%14s" % "-" if r["exit_spread_s"] is None \
+                else "%14.3f" % (r["exit_spread_s"] * 1e3)
+            lines.append("%-6s %-28s %-6d %-10s %-9s %14.3f %s"
+                         % (r["step"], r["label"][:28], len(r["ranks"]),
+                            r["last_to_enter"], exit_rank,
+                            r["enter_spread_s"] * 1e3, exit_sprd))
+        s = report["straggler_summary"]
+        lines.append("")
+        lines.append("blame (times last-to-enter): %s"
+                     % json.dumps(s["blame"]))
+        lines.append("worst rank: %s   max enter spread: %.3fms   "
+                     "mean: %.3fms"
+                     % (s["worst_rank"], s["max_enter_spread_s"] * 1e3,
+                        s["mean_enter_spread_s"] * 1e3))
+    else:
+        lines.append("no cross-rank collectives matched (single artifact "
+                     "or disjoint sequences)")
+    for p in report["problems"]:
+        lines.append("PROBLEM: %s" % p)
+    return "\n".join(lines)
+
+
+def run_analyze(paths, merged_out, as_json):
+    from incubator_mxnet_tpu.telemetry import aggregate
+    report, _trace = aggregate.analyze(paths, merged_out=merged_out)
+    if as_json:
+        print(json.dumps(report, indent=2, sort_keys=True, default=str))
+    else:
+        print(_render_analyze_text(report))
+    return 1 if report["problems"] else 0
+
+
+def analyze_selftest():
+    from incubator_mxnet_tpu.telemetry import aggregate
+    problems = aggregate.selftest()
+    if problems:
+        for p in problems:
+            print("graftlens analyze selftest FAIL: %s" % p,
+                  file=sys.stderr)
+        return 1
+    print("graftlens analyze selftest OK (merged trace valid, straggler "
+          "table blames the delayed rank)")
+    return 0
+
+
 def blackbox_selftest():
     """Flight-recorder lint smoke: full-pipeline dump + schema check."""
     from incubator_mxnet_tpu.telemetry import blackbox
@@ -307,7 +474,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m incubator_mxnet_tpu.telemetry",
         description="graftscope: segment-aware tracing + metrics summary; "
-                    "graftwatch: flight-recorder post-mortems")
+                    "graftwatch: flight-recorder post-mortems; graftlens: "
+                    "per-step attribution + cross-rank straggler analysis")
     ap.add_argument("--summary", action="store_true",
                     help="run (or load) a traced workload and report")
     ap.add_argument("--json", action="store_true",
@@ -319,6 +487,17 @@ def main(argv=None):
                     default=None,
                     help="render a flight-recorder dump (with --selftest: "
                          "validate the recorder pipeline instead)")
+    ap.add_argument("--analyze", metavar="ARTIFACT", nargs="*",
+                    default=None,
+                    help="merge + analyze N per-rank chrome traces and/or "
+                         "blackbox dumps (with --selftest: synthetic "
+                         "2-rank smoke)")
+    ap.add_argument("--merged", metavar="OUT",
+                    help="with --analyze: write the merged chrome trace "
+                         "here")
+    ap.add_argument("--steps", action="store_true",
+                    help="run a short training loop and render the "
+                         "graftlens per-step attribution ring")
     ap.add_argument("--top", type=int,
                     default=int(os.environ.get("GRAFT_TELEMETRY_TOPK",
                                                "10")),
@@ -327,6 +506,16 @@ def main(argv=None):
                     help="trace a 3-op bulked program and validate the "
                          "dump (CI smoke tier)")
     args = ap.parse_args(argv)
+
+    if args.analyze is not None:
+        if args.selftest:
+            return analyze_selftest()
+        if not args.analyze:
+            ap.error("--analyze needs artifact PATHs (or --selftest)")
+        return run_analyze(args.analyze, args.merged, args.json)
+
+    if args.steps:
+        return run_steps(args.json)
 
     if args.blackbox is not None:
         if args.selftest:

@@ -31,6 +31,7 @@ Render a dump with ``python -m incubator_mxnet_tpu.telemetry
 """
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import sys
@@ -41,12 +42,14 @@ import traceback
 from collections import deque
 from contextlib import nullcontext as _nullcontext
 
+from . import lens as _lens
+
 __all__ = ["enabled", "set_enabled", "record", "events", "stats",
            "in_flight", "inflight_entries", "progress", "last_progress",
            "collective", "phase_begin", "phase_end", "step_journal",
-           "workers_seen", "set_rank", "dump", "snapshot", "default_path",
-           "validate_dump", "summarize_dump", "install_hooks", "configure",
-           "selftest", "SCHEMA"]
+           "workers_seen", "set_rank", "set_clock_offset", "dump",
+           "snapshot", "default_path", "validate_dump", "summarize_dump",
+           "install_hooks", "configure", "selftest", "SCHEMA"]
 
 SCHEMA = "graft-blackbox/1"
 _DEFAULT_SIZE = 4096
@@ -83,6 +86,11 @@ _stats = [0]                    # events recorded ever (dropped = _stats[0]
 #                                 increment one bytecode away from atomic —
 #                                 a lost count under contention is harmless
 _rank = [0]
+_clock_offset = [None]          # latest heartbeat clock/arrival offset
+#                                 estimate vs the freshest-arriving rank
+#                                 (parallel/dist.py), recorded in dump
+#                                 headers so aggregate.py can align
+#                                 single dumps without matched anchors
 _started_at = time.time()
 
 
@@ -99,11 +107,25 @@ def set_rank(rank):
     _rank[0] = int(rank)
 
 
+def set_clock_offset(seconds):
+    """Record this rank's latest clock/arrival offset estimate from the
+    dist heartbeat (upper bound: includes arrival skew).  Lands in dump
+    headers as ``clock_offset_s`` for the cross-rank aggregator."""
+    _clock_offset[0] = float(seconds)
+
+
 def record(kind, **fields):
     """Append one structured event.  THE hot path: a disabled recorder
-    costs one env lookup; an enabled one adds one tuple + deque append."""
+    costs one env lookup; an enabled one adds one tuple + deque append.
+    graftlens threads its step id through: every event recorded from a
+    thread with lens activity carries ``step`` — the join key the
+    cross-rank aggregator uses."""
     if not enabled():
         return
+    if "step" not in fields:
+        step = _lens.current_step()
+        if step is not None:
+            fields["step"] = step
     _stats[0] += 1
     _ring.append((time.time(), kind, fields))
 
@@ -228,32 +250,73 @@ def _straggler_factor():
         return 3.0
 
 
-class _Collective(object):
-    __slots__ = ("path", "fields", "entry", "_t0")
+# collective sequence numbers: one process-wide monotonic counter.  The
+# collective issue order is SPMD-identical across ranks (the lockstep
+# contract every dist path already keeps), so the same seq on two ranks
+# IS the same wire collective — the matching key the cross-rank trace
+# aggregator and straggler table join on.
+_collective_seq = itertools.count(1)
 
-    def __init__(self, path, fields):
+
+class _Collective(object):
+    __slots__ = ("path", "fields", "entry", "_t0", "_bb")
+
+    def __init__(self, path, fields, bb=True):
         self.path = path
         self.fields = fields
         self.entry = None
+        self._bb = bb           # False: recorder off, bracket kept alive
+        #                         only for graftlens + chrome spans
 
     def __enter__(self):
         self._t0 = time.perf_counter()
-        self.entry = _push_inflight(
-            "collective", dict(self.fields, path=self.path))
+        fields = dict(self.fields, seq=next(_collective_seq))
+        step = _lens.current_step()
+        if step is not None:
+            fields["step"] = step
+        self.fields = fields
+        if self._bb:
+            self.entry = _push_inflight(
+                "collective", dict(fields, path=self.path))
         return self
 
     def __exit__(self, et, ev, tb):
         dt = time.perf_counter() - self._t0
         err = repr(ev) if et is not None else None
-        _pop_inflight(self.entry, error=err)
-        fields = dict(self.fields, path=self.path, rank=_rank[0],
-                      latency_ms=round(dt * 1e3, 3))
-        if err is not None:
-            fields["error"] = err
-        record("collective", **fields)
-        if err is None:
+        if self._bb:
+            _pop_inflight(self.entry, error=err)
+            fields = dict(self.fields, path=self.path, rank=_rank[0],
+                          latency_ms=round(dt * 1e3, 3))
+            if err is not None:
+                fields["error"] = err
+            record("collective", **fields)
+        # graftlens: a sync bracket blocks the host for its whole span —
+        # blocked == in-flight.  Async issues (reduce_many_async) are
+        # excluded: their bracket stays open across healthy overlap and
+        # the REAL blocked/in-flight split is reported by
+        # ReduceHandle.wait on the consumer side.
+        if self.path not in _NO_STRAGGLER_PATHS:
+            _lens.comm(self._t0, self._t0 + dt)
+        self._trace_span(dt)
+        if self._bb and err is None:
             self._straggler_check(dt)
         return False
+
+    def _trace_span(self, dt):
+        """Chrome-trace collective span (cat ``collective``) so traces —
+        not just flight-recorder dumps — carry the per-collective
+        enter/exit the cross-rank aggregator keys on."""
+        from .. import profiler as _prof
+        if not _prof._P.active():
+            return
+        end_us = _prof._now_us()
+        args = {"path": self.path, "rank": _rank[0]}
+        for k in ("seq", "step", "n_keys", "nbytes", "bucket"):
+            if self.fields.get(k) is not None:
+                args[k] = self.fields[k]
+        _prof.record_event(self.fields.get("bucket") or self.path,
+                           end_us - dt * 1e6, end_us, cat="collective",
+                           args=args)
 
     def _straggler_check(self, dt):
         """Slow-collective detection: a call beyond ``factor`` × its own
@@ -285,10 +348,20 @@ class _Collective(object):
 def collective(path, **fields):
     """Bracket one kvstore collective (push/pull/reduce_many/ps_*):
     records a ``collective`` ring event with latency + key/byte counts,
-    feeds the straggler EWMA, and shows up in-flight while running."""
-    if not enabled():
-        return _NULL
-    return _Collective(path, fields)
+    feeds the straggler EWMA, and shows up in-flight while running.
+    With the recorder off, graftlens' comm accounting and the profiler's
+    chrome collective spans must survive — the bracket then runs in
+    light mode (no ring/in-flight/EWMA, same seq/step stamping)."""
+    if enabled():
+        return _Collective(path, fields)
+    if _lens.enabled() or _profiler_active():
+        return _Collective(path, fields, bb=False)
+    return _NULL
+
+
+def _profiler_active():
+    from .. import profiler as _prof
+    return _prof._P.active()
 
 
 # ---------------------------------------------------------------------------
@@ -378,15 +451,52 @@ class _StepJournal(object):
             fields["error_phase"] = self.journal["error_phase"]
         if err is not None:
             fields["error"] = err
+        # graftlens: the journal boundary IS the step-window boundary —
+        # finalize the attribution window and fold the component
+        # breakdown into this ring event (the step event's `step` field
+        # then matches the id stamped on the window's flushes/collectives)
+        lens_rec = _lens.step_end(self.origin, extra=_lens_extra(self.fields))
+        if lens_rec is not None:
+            fields["step"] = lens_rec["step"]
+            fields["lens"] = _lens.compact(lens_rec)
         record("step", **fields)
+        return False
+
+
+def _lens_extra(fields):
+    extra = {k: fields[k] for k in ("overlapped", "fused", "batch_size")
+             if k in fields}
+    return extra or None
+
+
+class _LensOnlyStep(object):
+    """Step boundary for graftlens when the flight recorder is off: the
+    lens window must still close at step end (components would otherwise
+    pile into one endless first step)."""
+
+    __slots__ = ("origin", "fields")
+
+    def __init__(self, origin, fields):
+        self.origin = origin
+        self.fields = fields
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, et, ev, tb):
+        _lens.step_end(self.origin, extra=_lens_extra(self.fields))
         return False
 
 
 def step_journal(origin, **fields):
     """Bracket one optimizer step (gluon ``Trainer.step`` /
     ``Module.update``): phase latencies recorded inside land on ONE
-    ``step`` ring event with the device-memory highwater."""
+    ``step`` ring event with the device-memory highwater — and the
+    journal exit closes the graftlens attribution window (which keeps
+    working when the recorder itself is disabled)."""
     if not enabled():
+        if _lens.enabled():
+            return _LensOnlyStep(origin, fields)
         return _NULL
     return _StepJournal(origin, fields)
 
@@ -421,7 +531,24 @@ def workers_seen(table, skew=None, step=None):
 # ---------------------------------------------------------------------------
 
 def default_path():
-    return os.environ.get("GRAFT_BLACKBOX_PATH") or os.path.join(
+    """Dump destination.  A shared ``GRAFT_BLACKBOX_PATH`` is suffixed
+    with the dist rank for ranks > 0 — N workers honoring the same env
+    var used to overwrite each other's post-mortems; now rank 0 keeps
+    the configured path (single-process behavior unchanged) and every
+    other rank writes ``<stem>.rank<r><ext>`` alongside it, ready for
+    ``--analyze`` to consume the whole set.  A ``{rank}`` placeholder
+    substitutes exactly; a path whose filename already names this rank
+    (``rank<r>`` in the basename — the old per-worker guidance) is kept
+    verbatim, so existing per-rank deployments keep their paths."""
+    path = os.environ.get("GRAFT_BLACKBOX_PATH")
+    if path:
+        if "{rank}" in path:
+            return path.replace("{rank}", str(_rank[0]))
+        if _rank[0] and "rank%d" % _rank[0] not in os.path.basename(path):
+            root, ext = os.path.splitext(path)
+            path = "%s.rank%d%s" % (root, _rank[0], ext)
+        return path
+    return os.path.join(
         tempfile.gettempdir(), "graft_blackbox.%d.json" % os.getpid())
 
 
@@ -449,6 +576,7 @@ def snapshot(reason="manual", extra=None):
         "schema": SCHEMA,
         "pid": os.getpid(),
         "rank": _rank[0],
+        "clock_offset_s": _clock_offset[0],
         "reason": reason,
         "dumped_at": now,
         "started_at": _started_at,

@@ -1,0 +1,399 @@
+"""graftlens — per-step wall-time attribution.
+
+graftscope tells you what each *span* cost and graftwatch tells you what
+the process was doing when it died — but neither answers the question
+that drives every perf decision on this roadmap: **where did this step's
+wall time go?**  (EQuARX shows collective cost dominating distributed
+step time; the XLA fusion analysis shows device-time attribution is the
+prerequisite to every fusion/overlap decision — both need a per-step
+decomposition, not a pile of spans.)
+
+The lens decomposes every training step's wall clock into six components
+that sum EXACTLY to the step's wall time (the conservation contract,
+enforced by tests/test_lens.py):
+
+* ``data_wait``         — blocked in ``DataIter.next()`` / ``DataLoader``
+                          waiting for a batch,
+* ``forward``           — inside ``autograd.record()`` scopes and/or the
+                          ``fwd`` phase span (Module),
+* ``backward_compute``  — the ``bwd`` phase span (``autograd.backward``),
+* ``exposed_comm``      — host time *visibly* spent on communication:
+                          sync kvstore collective brackets,
+                          ``ReduceHandle.wait`` blocks, and the trainer's
+                          ``kvstore`` phase (reduce packing + waits),
+* ``optimizer_update``  — the ``update`` phase span,
+* ``host_gap``          — everything else (python glue, metric updates,
+                          logging, user code between batches).
+
+A *step window* runs from the end of the previous ``Trainer.step`` /
+``Module.update`` journal to the end of the current one, so the data
+fetch and forward of batch N land on step N — the whole loop is
+attributed, not just the optimizer call.  Sources report timestamped
+intervals; at step end the window is swept once and every elementary
+slice is attributed to the highest-priority covering category
+(``exposed_comm > optimizer_update > backward_compute > forward >
+data_wait``), so overlapping instrumentation (a collective bracket
+inside the kvstore phase, a record scope around a fwd span) can never
+double-count.  ``host_gap`` is the residual — the six components sum to
+the window by construction.
+
+Separately from the swept component, every step carries
+``comm_blocked_s`` (host time blocked in collectives) and
+``comm_inflight_s`` (summed issue→wait-return wall time of the same
+collectives — an upper bound on issue→ready, the same convention as
+graftlap's ``graft_trainer_overlap_ratio``).  On the serial reduce path
+the two are EQUAL by construction; under graftlap overlap
+``comm_blocked_s < comm_inflight_s`` — the difference bounds the
+communication hidden under backward.
+
+Steps live in an in-process ring of the last ``GRAFT_LENS_RING``
+(default 64) records, are published as ``graft_lens_*``
+gauges/histograms, are folded into the graftwatch step journal (the
+``lens`` field of ``step`` ring events), and — with
+``GRAFT_STEP_REPORT=N`` — print a one-line attribution report to stderr
+every N steps.  ``python -m incubator_mxnet_tpu.telemetry --steps``
+renders the ring; ``--analyze`` (telemetry/aggregate.py) merges
+per-rank artifacts into one cross-rank trace with straggler analytics.
+
+Master switch: ``GRAFT_LENS`` (default on; ``set_enabled`` overrides).
+The hot path per source event is one ``perf_counter`` + one list append;
+``lens_overhead_pct`` in ``bench_eager.py`` keeps the cost under the 2%
+bar.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+from . import metrics as _metrics
+
+__all__ = ["enabled", "set_enabled", "ring_size", "configure", "interval",
+           "phase", "io_wait", "comm", "step_end", "current_step", "steps",
+           "summary", "compact", "reset", "COMPONENTS", "ABBREV"]
+
+COMPONENTS = ("data_wait", "forward", "backward_compute", "exposed_comm",
+              "optimizer_update", "host_gap")
+
+# sweep priority, highest first: a slice covered by several categories is
+# attributed to the first one here (host_gap is the residual, never swept)
+_PRIORITY = ("exposed_comm", "optimizer_update", "backward_compute",
+             "forward", "data_wait")
+_PRIORITY_INDEX = {c: i for i, c in enumerate(_PRIORITY)}
+
+# phase-span name -> lens category (tracing._PhaseSpan feeds these)
+_PHASE_CATEGORY = {"kvstore": "exposed_comm", "update": "optimizer_update",
+                   "bwd": "backward_compute", "fwd": "forward"}
+
+_DEFAULT_RING = 64
+
+_enabled_override = None
+_generation = [0]       # bumped on every toggle: step windows spanning a
+#                         disabled period are dropped, not booked as one
+#                         giant host_gap "ghost step"
+
+
+def set_enabled(flag):
+    """Force the lens on/off (None = defer to GRAFT_LENS).  Toggling
+    invalidates every thread's open window — the first step after a
+    re-enable starts a fresh window instead of billing the whole
+    disabled period to host_gap."""
+    global _enabled_override
+    _enabled_override = flag
+    _generation[0] += 1
+
+
+def enabled():
+    if _enabled_override is not None:
+        return bool(_enabled_override)
+    return os.environ.get("GRAFT_LENS", "1").strip().lower() \
+        not in ("0", "false", "no", "off")
+
+
+def ring_size():
+    try:
+        n = int(os.environ.get("GRAFT_LENS_RING", str(_DEFAULT_RING)))
+    except ValueError:
+        return _DEFAULT_RING
+    return max(n, 4)
+
+
+_ring = deque(maxlen=ring_size())
+
+
+def configure(size=None):
+    """Re-size the step ring (keeps the newest records)."""
+    global _ring
+    if size is not None:
+        os.environ["GRAFT_LENS_RING"] = str(int(size))
+    _ring = deque(_ring, maxlen=ring_size())
+
+
+class _ThreadState(object):
+    """Per-thread step window: open intervals + counters.  Training loops
+    are single-threaded; a second stepping thread gets its own windows
+    (records from all threads share the ring)."""
+
+    __slots__ = ("intervals", "prev_end", "completed", "io_n", "coll_n",
+                 "comm_blocked", "comm_inflight", "gen")
+
+    def __init__(self):
+        self.intervals = []      # (category, t0, t1) in perf_counter secs
+        self.prev_end = None     # previous step's end (window start)
+        self.completed = 0       # steps finalized on this thread
+        self.io_n = 0
+        self.coll_n = 0
+        self.comm_blocked = 0.0
+        self.comm_inflight = 0.0
+        self.gen = _generation[0]
+
+    def reset_window(self):
+        self.intervals = []
+        self.prev_end = None
+        self.io_n = self.coll_n = 0
+        self.comm_blocked = self.comm_inflight = 0.0
+        self.gen = _generation[0]
+
+
+_tls = threading.local()
+
+
+def _state():
+    st = getattr(_tls, "lens", None)
+    if st is None:
+        st = _tls.lens = _ThreadState()
+    elif st.gen != _generation[0]:
+        st.reset_window()       # a toggle happened: the open window is
+        #                         unreliable, start fresh (step ids keep
+        #                         counting)
+    return st
+
+
+def current_step():
+    """Id of the calling thread's IN-PROGRESS step window (the one the
+    next ``step_end`` will finalize), or None when the lens is off or
+    the thread has produced no lens activity yet.  graftwatch stamps it
+    onto every flight-recorder event and tracing onto flush spans /
+    collective spans — the key the cross-rank aggregator joins on."""
+    if not enabled():
+        return None
+    st = getattr(_tls, "lens", None)
+    if st is None:
+        return None
+    return st.completed + 1
+
+
+# A loop that never crosses a step boundary (serving / evaluation — io
+# and forward hooks fire, step_end never does) must not grow the open
+# window without bound.  Past the cap the OLDEST intervals are dropped:
+# if a step eventually closes, the early slices degrade into host_gap
+# (conservation still holds); a window that large is degenerate anyway.
+_MAX_OPEN_INTERVALS = 8192
+
+
+def _append_interval(st, item):
+    iv = st.intervals
+    if len(iv) >= _MAX_OPEN_INTERVALS:
+        del iv[:_MAX_OPEN_INTERVALS // 2]
+    iv.append(item)
+
+
+def interval(category, t0, t1):
+    """Report one attributed interval (perf_counter seconds).  THE hot
+    path: an env lookup, a getattr and a list append."""
+    if t1 <= t0 or not enabled():
+        return
+    _append_interval(_state(), (category, t0, t1))
+
+
+def phase(name, t0, t1):
+    """One closed phase span (tracing._PhaseSpan)."""
+    cat = _PHASE_CATEGORY.get(name)
+    if cat is not None:
+        interval(cat, t0, t1)
+
+
+def io_wait(t0, t1):
+    """Host blocked waiting for a data batch (io/DataLoader)."""
+    if t1 <= t0 or not enabled():
+        return
+    st = _state()
+    st.io_n += 1
+    _append_interval(st, ("data_wait", t0, t1))
+
+
+def comm(t0, t1, inflight=None):
+    """Host blocked in one collective.  ``inflight`` is the collective's
+    issue→wait-return wall time when it differs from the blocked span
+    (graftlap async reduces: issued mid-backward, waited in step; an
+    upper bound on issue→ready when waits queue behind each other) —
+    sync collectives leave it None and the two book equal."""
+    if not enabled():
+        return
+    st = _state()
+    st.coll_n += 1
+    blocked = max(t1 - t0, 0.0)
+    st.comm_blocked += blocked
+    st.comm_inflight += blocked if inflight is None \
+        else max(float(inflight), 0.0)
+    if blocked > 0.0:
+        _append_interval(st, ("exposed_comm", t0, t1))
+
+
+def _attribute(intervals, w0, w1):
+    """Sweep the window once: every elementary slice goes to the
+    highest-priority category covering it.  Returns (per-category
+    seconds, total attributed seconds) — total <= w1 - w0 always, so
+    the residual (host_gap) is non-negative by construction."""
+    comp = {c: 0.0 for c in _PRIORITY}
+    marks = []
+    for cat, t0, t1 in intervals:
+        t0 = max(t0, w0)
+        t1 = min(t1, w1)
+        if t1 <= t0:
+            continue
+        pr = _PRIORITY_INDEX[cat]
+        marks.append((t0, 1, pr))
+        marks.append((t1, 0, pr))    # closes sort before opens at ties
+    if not marks:
+        return comp, 0.0
+    marks.sort()
+    active = [0] * len(_PRIORITY)
+    last_t = None
+    total = 0.0
+    for t, kind, pr in marks:
+        if last_t is not None and t > last_t and any(active):
+            for i, n in enumerate(active):
+                if n > 0:
+                    d = t - last_t
+                    comp[_PRIORITY[i]] += d
+                    total += d
+                    break
+        active[pr] += 1 if kind == 1 else -1
+        last_t = t
+    return comp, total
+
+
+def step_end(origin="step", extra=None):
+    """Finalize the calling thread's step window (called from the
+    graftwatch step journal).  Returns the ring record (None when the
+    lens is off)."""
+    if not enabled():
+        return None
+    st = _state()
+    now = time.perf_counter()
+    w0 = st.prev_end
+    if w0 is None:      # first step: window starts at the first activity
+        w0 = min((t0 for _c, t0, _t1 in st.intervals), default=now)
+    wall = max(now - w0, 0.0)
+    comp, attributed = _attribute(st.intervals, w0, now)
+    comp["host_gap"] = max(wall - attributed, 0.0)
+    st.completed += 1
+    rec = {
+        "step": st.completed,
+        "origin": origin,
+        "ended_at": time.time(),
+        "wall_s": wall,
+        "components": comp,
+        "comm_blocked_s": st.comm_blocked,
+        "comm_inflight_s": st.comm_inflight,
+        "collectives": st.coll_n,
+        "io_waits": st.io_n,
+        "thread": threading.current_thread().name,
+    }
+    if extra:
+        rec.update(extra)
+    st.intervals = []
+    st.prev_end = now
+    st.io_n = st.coll_n = 0
+    st.comm_blocked = st.comm_inflight = 0.0
+    _ring.append(rec)
+    _metrics.lens_step(rec)
+    _maybe_report(rec)
+    return rec
+
+
+def compact(rec):
+    """Millisecond-rounded view of one record — what the graftwatch step
+    journal embeds under its ``lens`` field."""
+    out = {"wall_ms": round(rec["wall_s"] * 1e3, 3)}
+    for c in COMPONENTS:
+        out[c + "_ms"] = round(rec["components"][c] * 1e3, 3)
+    out["comm_blocked_ms"] = round(rec["comm_blocked_s"] * 1e3, 3)
+    out["comm_inflight_ms"] = round(rec["comm_inflight_s"] * 1e3, 3)
+    return out
+
+
+def steps():
+    """The ring, oldest first (copies)."""
+    return [dict(r, components=dict(r["components"])) for r in list(_ring)]
+
+
+def reset():
+    """Drop the ring AND the calling thread's open window (tests)."""
+    _ring.clear()
+    _tls.lens = None
+
+
+def summary(records=None):
+    """Aggregate view over the ring (or an explicit record list)."""
+    recs = list(_ring) if records is None else list(records)
+    if not recs:
+        return {"steps": 0}
+    wall = sum(r["wall_s"] for r in recs)
+    comp = {c: sum(r["components"][c] for r in recs) for c in COMPONENTS}
+    return {
+        "steps": len(recs),
+        "wall_s": wall,
+        "mean_step_ms": round(wall / len(recs) * 1e3, 3),
+        "components_s": {c: round(v, 6) for c, v in comp.items()},
+        "fractions": {c: round(comp[c] / wall, 4) if wall > 0 else 0.0
+                      for c in COMPONENTS},
+        "comm_blocked_s": round(sum(r["comm_blocked_s"] for r in recs), 6),
+        "comm_inflight_s": round(sum(r["comm_inflight_s"] for r in recs), 6),
+    }
+
+
+# ---------------------------------------------------------------------------
+# GRAFT_STEP_REPORT=N: the live attribution line
+# ---------------------------------------------------------------------------
+
+def _report_every():
+    try:
+        return int(os.environ.get("GRAFT_STEP_REPORT", "0"))
+    except ValueError:
+        return 0
+
+
+ABBREV = (("data_wait", "data"), ("forward", "fwd"),
+           ("backward_compute", "bwd"), ("exposed_comm", "comm"),
+           ("optimizer_update", "upd"), ("host_gap", "gap"))
+
+
+def format_step(rec):
+    parts = " ".join("%s %.2f" % (short, rec["components"][c] * 1e3)
+                     for c, short in ABBREV)
+    line = "graftlens step %d (%s): %.2fms | %s [ms]" % (
+        rec["step"], rec["origin"], rec["wall_s"] * 1e3, parts)
+    if rec["comm_inflight_s"] > rec["comm_blocked_s"]:
+        line += " | comm exposed %.2f / in-flight %.2f ms" % (
+            rec["comm_blocked_s"] * 1e3, rec["comm_inflight_s"] * 1e3)
+    return line
+
+
+def _maybe_report(rec):
+    n = _report_every()
+    if n <= 0 or rec["step"] % n:
+        return
+    lines = [format_step(rec)]
+    agg = summary(list(_ring)[-n:])
+    if agg.get("steps", 0) > 1:
+        fr = agg["fractions"]
+        lines.append(
+            "graftlens last %d steps: mean %.2fms | %s" % (
+                agg["steps"], agg["mean_step_ms"],
+                " ".join("%s %d%%" % (short, round(fr[c] * 100))
+                         for c, short in ABBREV)))
+    sys.stderr.write("\n".join(lines) + "\n")

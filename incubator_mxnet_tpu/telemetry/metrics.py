@@ -589,6 +589,40 @@ def trainer_fused_update(n_params):
         n_params)
 
 
+# -- graftlens: per-step wall-time attribution --------------------------------
+
+
+def lens_step(rec):
+    """One finalized lens step window (telemetry/lens.py): per-component
+    seconds histogram, last-step fraction gauges, and the hidden-comm
+    ratio (1 - blocked/inflight collective time — the overlap view)."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    r.counter("graft_lens_steps_total",
+              "Training steps attributed by graftlens").inc()
+    h = r.histogram("graft_lens_component_seconds",
+                    "Per-step wall time by lens component", ("component",),
+                    buckets=_PHASE_BUCKETS)
+    g = r.gauge("graft_lens_component_fraction",
+                "Last step's wall-time fraction by lens component",
+                ("component",))
+    wall = rec["wall_s"]
+    for c, v in rec["components"].items():
+        h.observe(v, component=c)
+        g.set(v / wall if wall > 0 else 0.0, component=c)
+    r.histogram("graft_lens_step_seconds",
+                "Attributed step wall time (window end to end)", (),
+                buckets=_PHASE_BUCKETS).observe(wall)
+    if rec["comm_inflight_s"] > 0:
+        r.gauge("graft_lens_comm_hidden_ratio",
+                "1 - blocked/in-flight collective time of the last "
+                "COMM-BEARING step (holds its value across comm-free "
+                "steps; how much comm the overlap hid)").set(
+            max(0.0, min(1.0, 1.0 - rec["comm_blocked_s"]
+                         / rec["comm_inflight_s"])))
+
+
 # -- graftwatch: watchdog + dist liveness ------------------------------------
 
 _SKEW_BUCKETS = (1e-4, 1e-3, 1e-2, 0.1, 0.5, 1.0, 5.0, 30.0)

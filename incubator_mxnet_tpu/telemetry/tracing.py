@@ -29,11 +29,13 @@ import itertools
 import time
 
 from . import blackbox as _blackbox
+from . import lens as _lens
 from . import metrics as _metrics
 
 __all__ = ["phase_span", "next_segment_id", "record_active",
            "deferred_op_event", "segment_flush_span",
-           "segment_summary", "validate_chrome_trace"]
+           "segment_summary", "validate_chrome_trace",
+           "process_metadata_events", "trace_header"]
 
 _segment_ids = itertools.count(1)
 
@@ -87,6 +89,9 @@ def segment_flush_span(segment, cause, begin_us, end_us, flow_indices,
             "cache": "hit" if cache_hit else "miss",
             "recorded": bool(recorded),
             "device_time": bool(device_time)}
+    step = _lens.current_step()
+    if step is not None:
+        args["step"] = step      # graftlens: flush → step attribution key
     if error:
         args["error"] = True
     p.record_event(SEGMENT_SPAN, begin_us, end_us, cat="engine", args=args)
@@ -131,6 +136,7 @@ class _PhaseSpan(object):
             p.record_event(self.phase, self._begin, p._now_us(),
                            cat="phase", args=args)
         _metrics.phase(self.phase, dt)
+        _lens.phase(self.phase, self._t0, self._t0 + dt)
         _blackbox.phase_end(self._bb, self.phase, dt,
                             error=exc_type is not None)
         return False
@@ -151,11 +157,54 @@ _NULL = _NullSpan()
 
 def phase_span(phase, args=None):
     """Context manager for one fwd/bwd/update/kvstore phase.  Free when
-    the profiler, telemetry AND the flight recorder are all off."""
+    the profiler, telemetry, the flight recorder AND the lens are all
+    off."""
     if not _metrics.enabled() and not _prof()._P.active() \
-            and not _blackbox.enabled():
+            and not _blackbox.enabled() and not _lens.enabled():
         return _NULL
     return _PhaseSpan(phase, args)
+
+
+# ---------------------------------------------------------------------------
+# trace identity: process/thread metadata + wall-clock anchor
+# ---------------------------------------------------------------------------
+
+def process_metadata_events(rank=None, role=None, pid=None):
+    """Chrome-trace ``M`` metadata events labeling this process's track
+    (``process_name``/``process_sort_index``/``thread_name``).  The
+    merged cross-rank trace (telemetry/aggregate.py) emits one set per
+    rank so each rank renders as its own named process row; the profiler
+    prepends a set to every single-rank dump so the merge can identify
+    the rank without side channels."""
+    if rank is None:
+        rank = _blackbox._rank[0]
+    name = "rank %d" % rank
+    if role:
+        name += " (%s)" % role
+    if pid is None:
+        pid = 0
+    return [
+        {"name": "process_name", "ph": "M", "pid": pid,
+         "args": {"name": name}},
+        {"name": "process_sort_index", "ph": "M", "pid": pid,
+         "args": {"sort_index": int(rank)}},
+        {"name": "thread_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": "main"}},
+    ]
+
+
+def trace_header():
+    """(metadata events, otherData) for a chrome-trace dump.  The wall
+    anchor maps the profiler's monotonic microsecond clock to wall-clock
+    seconds, which is what lets the aggregator put N ranks' traces (and
+    flight-recorder dumps) on one timeline."""
+    from .. import profiler as _p
+    other = {"rank": _blackbox._rank[0],
+             "wall_anchor": {"perf_us": _p._now_us(),
+                             "wall_s": time.time()}}
+    if _blackbox._clock_offset[0] is not None:
+        other["clock_offset_s"] = _blackbox._clock_offset[0]
+    return process_metadata_events(), other
 
 
 # ---------------------------------------------------------------------------
@@ -193,23 +242,31 @@ def segment_summary(events, top=10):
 
 def validate_chrome_trace(trace):
     """Schema + flow-link validation of a dumped trace dict.  Returns a
-    list of problems (empty == valid).  Used by the lint smoke tier."""
+    list of problems (empty == valid).  Used by the lint smoke tier.
+    Accepts ``M`` metadata events (process_name/thread_name rows of
+    merged cross-rank traces) and multi-hop flows (``s`` → any number of
+    ``t`` steps → ``f``, the shape the cross-rank collective links
+    use)."""
     problems = []
     events = trace.get("traceEvents")
     if not isinstance(events, list):
         return ["traceEvents missing or not a list"]
-    starts, finishes = {}, {}
+    starts, finishes, hops = {}, {}, {}
     for i, e in enumerate(events):
         if not isinstance(e, dict) or "ph" not in e or "name" not in e:
             problems.append("event %d: missing ph/name" % i)
             continue
         ph = e["ph"]
-        if ph in ("X", "s", "f", "i", "C") and "ts" not in e:
+        if ph in ("X", "s", "t", "f", "i", "C") and "ts" not in e:
             problems.append("event %d (%s): missing ts" % (i, ph))
         if ph == "X" and e.get("dur", 0) < 0:
             problems.append("event %d: negative dur" % i)
+        if ph == "M" and not isinstance(e.get("args"), dict):
+            problems.append("event %d (M): missing args" % i)
         if ph == "s":
             starts.setdefault(e.get("id"), []).append(i)
+        elif ph == "t":
+            hops.setdefault(e.get("id"), []).append(i)
         elif ph == "f":
             finishes.setdefault(e.get("id"), []).append(i)
     for fid, idxs in starts.items():
@@ -222,4 +279,7 @@ def validate_chrome_trace(trace):
             problems.append("flow id %r finished %d times" % (fid, len(idxs)))
         if fid not in starts:
             problems.append("flow id %r finishes without a start" % fid)
+    for fid in hops:
+        if fid not in starts:
+            problems.append("flow id %r has a step without a start" % fid)
     return problems

@@ -1,0 +1,661 @@
+"""graftlens tests: per-step attribution conservation, overlap-aware
+comm accounting, step-id threading, the cross-rank aggregator +
+straggler table, metadata/flow trace validation, the rank-suffixed dump
+path, and the 2-proc dist harness with a deliberately delayed rank.
+
+Covers the ISSUE-8 acceptance surface: the six lens components must sum
+to the measured step wall time (including an overlapped PR-7 step where
+``exposed_comm`` < total collective time and a serial step where they
+are equal), and ``--analyze`` over two ranks' artifacts must produce a
+schema-valid merged chrome trace with per-rank tracks, cross-rank flow
+links per reduced bucket, and a straggler table naming the delayed
+rank.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon
+from incubator_mxnet_tpu.telemetry import aggregate, blackbox, lens
+from incubator_mxnet_tpu.telemetry import tracing as ttracing
+from incubator_mxnet_tpu.telemetry.__main__ import main as telemetry_main
+
+
+@pytest.fixture
+def fresh_lens():
+    """A clean, force-enabled lens for one test."""
+    lens.set_enabled(True)
+    lens.reset()
+    yield lens
+    lens.reset()
+    lens.set_enabled(None)
+
+
+def _build_params(n, shape=(8, 8), prefix="lp", seed=0):
+    rs = np.random.RandomState(seed)
+    ps = []
+    for k in range(n):
+        p = gluon.Parameter("%s%d" % (prefix, k), shape=shape)
+        p.initialize(ctx=mx.cpu())
+        p.data()._write(rs.randn(*shape).astype(np.float32))
+        ps.append(p)
+    return ps
+
+
+def _train_steps(ps, trainer, n):
+    for _ in range(n):
+        with autograd.record():
+            loss = None
+            for p in ps:
+                y = (p.data() * p.data()).sum()
+                loss = y if loss is None else loss + y
+        loss.backward()
+        trainer.step(1)
+    ps[-1].data().asnumpy()
+
+
+def _assert_conserved(rec):
+    total = sum(rec["components"].values())
+    assert total == pytest.approx(rec["wall_s"], abs=1e-6), \
+        (rec["components"], rec["wall_s"])
+    for v in rec["components"].values():
+        assert v >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# attribution conservation
+# ---------------------------------------------------------------------------
+
+def test_components_sum_to_step_wall_time(fresh_lens):
+    """The conservation contract over a full training loop with every
+    source lit: io iterator, record scope, backward, a local kvstore,
+    the fused update."""
+    from incubator_mxnet_tpu import io
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    rs = np.random.RandomState(0)
+    x = rs.rand(24, 8).astype(np.float32)
+    y = np.zeros((24, 4), np.float32)
+    net(mx.nd.array(x[:4])).asnumpy()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1},
+                            kvstore=mx.kv.create("local"))
+    it = io.NDArrayIter(data=x, label=y, batch_size=4)
+    for batch in it:
+        with autograd.record():
+            out = net(batch.data[0])
+            loss = (out * out).mean()
+        loss.backward()
+        trainer.step(4)
+        loss.asnumpy()
+    recs = lens.steps()
+    assert len(recs) == 6
+    for rec in recs:
+        _assert_conserved(rec)
+    # steady-state steps exercise every component source
+    steady = recs[-1]
+    assert steady["components"]["forward"] > 0
+    assert steady["components"]["backward_compute"] > 0
+    assert steady["components"]["optimizer_update"] > 0
+    assert steady["components"]["exposed_comm"] > 0   # kv push/pull
+    assert any(r["components"]["data_wait"] > 0 for r in recs)
+    assert steady["io_waits"] >= 1 and steady["collectives"] >= 1
+
+
+def test_overlapped_step_hides_comm_serial_step_does_not(fresh_lens):
+    """ISSUE-8 conservation satellite: on the overlapped (PR 7) path
+    ``exposed_comm`` (blocked) < total collective in-flight time; with
+    GRAFT_OVERLAP off the two book EQUAL by construction.  Conservation
+    holds on both."""
+    def run(overlap, prefix):
+        lens.reset()
+        ps = _build_params(8, prefix=prefix)
+        t = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01},
+                          kvstore=mx.kv.create("dist_sync"))
+        t._bucket_bytes_override = 1024
+        t._overlap_override = overlap
+        _train_steps(ps, t, 4)
+        return lens.steps()
+
+    serial = run(False, "ls")
+    for rec in serial:
+        _assert_conserved(rec)
+        # sync brackets book blocked == in-flight identically
+        assert rec["comm_blocked_s"] == rec["comm_inflight_s"]
+
+    overlapped = run(True, "lo")
+    for rec in overlapped:
+        _assert_conserved(rec)
+    last = overlapped[-1]
+    assert last.get("overlapped") is True
+    # the reduce was issued mid-backward: its in-flight span covers the
+    # rest of the walk, while step() only paid the wait
+    assert last["comm_blocked_s"] < last["comm_inflight_s"]
+
+
+def test_lens_survives_disabled_blackbox(fresh_lens):
+    """Step windows must close (via _LensOnlyStep) AND collective
+    brackets must keep feeding comm accounting (light-mode bracket)
+    when the flight recorder is off."""
+    prev = blackbox._enabled_override
+    blackbox.set_enabled(False)
+    before = len(blackbox.events())
+    try:
+        ps = _build_params(2, prefix="lb")
+        t = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01},
+                          kvstore=mx.kv.create("local"))
+        _train_steps(ps, t, 3)
+        assert len(blackbox.events()) == before    # recorder really off
+    finally:
+        blackbox.set_enabled(prev)
+    recs = lens.steps()
+    assert len(recs) == 3
+    for rec in recs:
+        _assert_conserved(rec)
+    # the kvstore reduce still booked as exposed communication
+    assert recs[-1]["collectives"] >= 1
+    assert recs[-1]["comm_blocked_s"] > 0
+    assert recs[-1]["components"]["exposed_comm"] > 0
+
+
+def test_disabled_lens_is_a_noop():
+    lens.set_enabled(False)
+    try:
+        lens.reset()
+        lens.interval("forward", 0.0, 1.0)
+        lens.io_wait(0.0, 1.0)
+        lens.comm(0.0, 1.0)
+        assert lens.step_end("t") is None
+        assert lens.steps() == []
+        assert lens.current_step() is None
+    finally:
+        lens.set_enabled(None)
+        lens.reset()
+
+
+def test_open_window_is_bounded_without_step_boundaries(fresh_lens):
+    """A serving/eval loop (hooks fire, step_end never does) must not
+    grow the open window without bound."""
+    for i in range(3 * lens._MAX_OPEN_INTERVALS):
+        lens.io_wait(float(i), float(i) + 0.5)
+    st = lens._state()
+    assert len(st.intervals) <= lens._MAX_OPEN_INTERVALS
+    rec = lens.step_end("eval")        # a late step still conserves
+    _assert_conserved(rec)
+
+
+def test_toggle_does_not_book_ghost_step(fresh_lens):
+    """A window left open across a disabled period must be dropped on
+    re-enable, not billed as one giant host_gap step."""
+    ps = _build_params(2, prefix="lg")
+    t = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01}, kvstore=None)
+    _train_steps(ps, t, 1)
+    lens.set_enabled(False)
+    time.sleep(0.2)                        # "trains" with the lens off
+    lens.set_enabled(True)
+    _train_steps(ps, t, 1)
+    recs = lens.steps()
+    assert len(recs) == 2
+    _assert_conserved(recs[-1])
+    # the disabled 0.2s must NOT appear in the re-enabled step's window
+    assert recs[-1]["wall_s"] < 0.15, recs[-1]
+
+
+def test_priority_sweep_never_double_counts(fresh_lens):
+    """Overlapping intervals of different categories attribute each
+    elementary slice exactly once, highest priority first."""
+    # forward covers [0, 10]; bwd [4, 8] nested; comm [6, 12] overlaps
+    intervals = [("forward", 0.0, 10.0),
+                 ("backward_compute", 4.0, 8.0),
+                 ("exposed_comm", 6.0, 12.0)]
+    comp, attributed = lens._attribute(intervals, 0.0, 20.0)
+    assert comp["forward"] == pytest.approx(4.0)           # [0,4]
+    assert comp["backward_compute"] == pytest.approx(2.0)  # [4,6]
+    assert comp["exposed_comm"] == pytest.approx(6.0)      # [6,12]
+    assert attributed == pytest.approx(12.0)
+    # clipping to the window
+    comp, attributed = lens._attribute(intervals, 5.0, 11.0)
+    assert comp["forward"] == pytest.approx(0.0)
+    assert comp["backward_compute"] == pytest.approx(1.0)  # [5,6]
+    assert comp["exposed_comm"] == pytest.approx(5.0)      # [6,11]
+    assert attributed == pytest.approx(6.0)
+
+
+def test_ring_bound_and_report(fresh_lens, capfd, monkeypatch):
+    monkeypatch.setenv("GRAFT_LENS_RING", "4")
+    monkeypatch.setenv("GRAFT_STEP_REPORT", "2")
+    lens.configure()
+    try:
+        ps = _build_params(2, prefix="lr")
+        t = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01}, kvstore=None)
+        _train_steps(ps, t, 6)
+        recs = lens.steps()
+        assert len(recs) == 4                  # ring bound
+        assert recs[-1]["step"] == 6
+        err = capfd.readouterr().err
+        assert "graftlens step 2" in err and "graftlens step 6" in err
+        assert "graftlens step 3" not in err   # off-cadence steps silent
+    finally:
+        monkeypatch.delenv("GRAFT_LENS_RING")
+        lens.configure()
+
+
+# ---------------------------------------------------------------------------
+# step-id threading (flushes + collectives + journals share the key)
+# ---------------------------------------------------------------------------
+
+def test_step_id_threaded_through_ring_events(fresh_lens):
+    blackbox.set_enabled(True)
+    blackbox._ring.clear()
+    try:
+        ps = _build_params(4, prefix="lt")
+        t = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01},
+                          kvstore=mx.kv.create("local"))
+        _train_steps(ps, t, 3)
+        evs = blackbox.events()
+        steps = [e["data"] for e in evs if e["kind"] == "step"]
+        assert [s["step"] for s in steps] == [1, 2, 3]
+        assert all("lens" in s for s in steps)
+        # collectives carry the step they ran under plus a lockstep seq
+        colls = [e["data"] for e in evs if e["kind"] == "collective"]
+        assert colls
+        assert all("seq" in c for c in colls)
+        seqs = [c["seq"] for c in colls]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        coll_steps = {c["step"] for c in colls if "step" in c}
+        assert coll_steps and coll_steps <= {1, 2, 3}
+        # the journal's lens fold conserves too (ms view)
+        fold = steps[-1]["lens"]
+        parts = sum(fold[c + "_ms"] for c in lens.COMPONENTS)
+        assert parts == pytest.approx(fold["wall_ms"], abs=0.01)
+    finally:
+        blackbox.set_enabled(None)
+
+
+# ---------------------------------------------------------------------------
+# chrome-trace metadata + flow-step validation (satellite)
+# ---------------------------------------------------------------------------
+
+def test_process_metadata_events_label_tracks():
+    evs = ttracing.process_metadata_events(rank=3, role="blackbox", pid=3)
+    names = {e["name"]: e for e in evs}
+    assert names["process_name"]["args"]["name"] == "rank 3 (blackbox)"
+    assert names["process_sort_index"]["args"]["sort_index"] == 3
+    assert names["thread_name"]["pid"] == 3
+
+
+def test_validator_accepts_metadata_and_multi_hop_flows():
+    trace = {"traceEvents": (
+        ttracing.process_metadata_events(rank=0)
+        + [{"name": "c", "cat": "x", "ph": "X", "ts": 1.0, "dur": 2.0,
+            "pid": 0, "tid": 0},
+           {"name": "l", "cat": "f", "ph": "s", "id": "a", "ts": 1.0,
+            "pid": 0, "tid": 0},
+           {"name": "l", "cat": "f", "ph": "t", "id": "a", "ts": 2.0,
+            "pid": 1, "tid": 0},
+           {"name": "l", "cat": "f", "ph": "f", "bp": "e", "id": "a",
+            "ts": 3.0, "pid": 2, "tid": 0}])}
+    assert ttracing.validate_chrome_trace(trace) == []
+    # a hop without a start is still a problem
+    bad = {"traceEvents": [
+        {"name": "l", "cat": "f", "ph": "t", "id": "zz", "ts": 1.0,
+         "pid": 0, "tid": 0}]}
+    assert any("without a start" in p
+               for p in ttracing.validate_chrome_trace(bad))
+    # M events must carry args
+    assert any("(M)" in p for p in ttracing.validate_chrome_trace(
+        {"traceEvents": [{"name": "process_name", "ph": "M", "pid": 0}]}))
+
+
+def test_profiler_dump_carries_metadata_and_wall_anchor(tmp_path):
+    from incubator_mxnet_tpu import profiler
+    path = str(tmp_path / "trace.json")
+    profiler.set_config(filename=path, profile_all=True)
+    profiler.set_state("run")
+    (mx.nd.ones((4, 4)) + 1).asnumpy()
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(path) as f:
+        doc = json.load(f)
+    assert ttracing.validate_chrome_trace(doc) == []
+    assert any(e.get("ph") == "M" and e.get("name") == "process_name"
+               for e in doc["traceEvents"])
+    anchor = doc["otherData"]["wall_anchor"]
+    assert abs(anchor["wall_s"] - time.time()) < 60.0
+    assert doc["otherData"]["rank"] == blackbox._rank[0]
+
+
+# ---------------------------------------------------------------------------
+# multi-rank dump path (satellite)
+# ---------------------------------------------------------------------------
+
+def test_blackbox_dump_path_rank_suffix(tmp_path, monkeypatch):
+    monkeypatch.setenv("GRAFT_BLACKBOX_PATH", str(tmp_path / "bb.json"))
+    try:
+        blackbox.set_rank(0)
+        assert blackbox.default_path() == str(tmp_path / "bb.json")
+        blackbox.set_rank(2)
+        assert blackbox.default_path() == str(tmp_path / "bb.rank2.json")
+        # a path already naming this rank (old per-worker guidance) is
+        # kept verbatim; a {rank} placeholder substitutes exactly
+        monkeypatch.setenv("GRAFT_BLACKBOX_PATH",
+                           str(tmp_path / "bb_rank2.json"))
+        assert blackbox.default_path() == str(tmp_path / "bb_rank2.json")
+        monkeypatch.setenv("GRAFT_BLACKBOX_PATH",
+                           str(tmp_path / "bb.{rank}.json"))
+        assert blackbox.default_path() == str(tmp_path / "bb.2.json")
+        blackbox.set_clock_offset(0.125)
+        doc = blackbox.snapshot()
+        assert doc["rank"] == 2 and doc["clock_offset_s"] == 0.125
+    finally:
+        blackbox.set_rank(0)
+        blackbox._clock_offset[0] = None
+
+
+# ---------------------------------------------------------------------------
+# cross-rank aggregation + straggler table
+# ---------------------------------------------------------------------------
+
+def test_aggregate_selftest_passes():
+    assert aggregate.selftest() == []
+
+
+def test_aggregate_blames_delayed_rank(tmp_path):
+    delay = 0.2
+    paths = []
+    for rank in (0, 1):
+        p = tmp_path / ("rank%d.json" % rank)
+        p.write_text(json.dumps(aggregate._synthetic_dump(rank, delay)))
+        paths.append(str(p))
+    merged_path = str(tmp_path / "merged.json")
+    report, trace = aggregate.analyze(paths, merged_out=merged_path)
+    assert report["problems"] == []
+    assert ttracing.validate_chrome_trace(trace) == []
+    s = report["straggler_summary"]
+    assert s["worst_rank"] == 1
+    assert s["max_enter_spread_s"] == pytest.approx(delay, abs=0.02)
+    assert s["blame"]["1"] == s["collectives_matched"] > 0
+    # per-rank process tracks + >=1 flow link per reduced bucket
+    pids = {e["pid"] for e in trace["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert pids == {0, 1}
+    labels = {r["label"] for r in report["stragglers"]}
+    assert len(labels) == 2
+    assert report["cross_rank_flow_links"] >= len(labels)
+    flow_ids = {e["id"] for e in trace["traceEvents"]
+                if e.get("ph") in ("s", "t", "f")}
+    assert any(str(f).startswith("xr/") for f in flow_ids)
+    with open(merged_path) as f:
+        assert ttracing.validate_chrome_trace(json.load(f)) == []
+
+
+def test_async_collectives_never_corrupt_clock_or_exit_blame(tmp_path):
+    """Overlapped (reduce_many_async) events are stamped at host-local
+    wait-return time: they must not serve as clock anchors (a healthy
+    40ms host lag before wait() would fabricate a 40ms offset) nor as
+    exit-spread evidence."""
+    base = 1700000000.0
+    lag = 0.04                    # rank 0 reaches wait() 40ms late
+    docs = {}
+    for rank in (0, 1):
+        events = []
+        for step in range(1, 4):
+            t = base + step * 0.5
+            # async reduce: both ranks ISSUE together (enter == t), but
+            # rank 0's host returns from wait() `lag` later
+            exit_ = t + 0.1 + (lag if rank == 0 else 0.0)
+            events.append({"ts": exit_, "kind": "collective", "data": {
+                "path": "reduce_many_async", "seq": step, "step": step,
+                "bucket": "bucket[float32:8p:2048B]",
+                "latency_ms": (exit_ - t) * 1e3}})
+            events.append({"ts": t + 0.3, "kind": "dist_heartbeat",
+                           "data": {"workers": 2, "step": step}})
+        docs[rank] = dict(aggregate._synthetic_dump(rank, 0.0),
+                          events=events, events_total=len(events))
+        (tmp_path / ("a%d.json" % rank)).write_text(json.dumps(docs[rank]))
+    report, _trace = aggregate.analyze([str(tmp_path / "a0.json"),
+                                        str(tmp_path / "a1.json")])
+    assert report["problems"] == []
+    # clocks really are synced: the async wait lag must not leak in
+    assert abs(report["clock_offsets_s"]["1"]) < 1e-6, report
+    rows = report["stragglers"]
+    assert rows
+    for r in rows:
+        assert r["last_to_exit"] is None and r["exit_spread_s"] is None
+        assert r["enter_spread_s"] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_aggregate_mixed_trace_and_dump(tmp_path, fresh_lens):
+    """A real profiler trace of this process merges with a synthetic
+    peer dump: collective chrome spans carry seq/step so the join works
+    across artifact kinds."""
+    from incubator_mxnet_tpu import profiler
+    blackbox.set_enabled(True)
+    blackbox._ring.clear()
+    tracefile = str(tmp_path / "r0_trace.json")
+    try:
+        seq0 = next(blackbox._collective_seq)
+        profiler.set_config(filename=tracefile, profile_all=True)
+        profiler.set_state("run")
+        kv = mx.kv.create("local")
+        kv.init("w", mx.nd.ones((8,)))
+        kv.push("w", mx.nd.ones((8,)))
+        out = mx.nd.zeros((8,))
+        kv.pull("w", out=out)
+        out.asnumpy()
+        profiler.set_state("stop")
+        profiler.dump()
+    finally:
+        blackbox.set_enabled(None)
+    with open(tracefile) as f:
+        doc = json.load(f)
+    colls = [e for e in doc["traceEvents"]
+             if e.get("cat") == "collective" and e.get("ph") == "X"]
+    assert colls and all("seq" in e["args"] for e in colls)
+    # a synthetic rank-1 dump whose collectives reuse the same seqs
+    wall = aggregate._wall_fn(doc["otherData"]["wall_anchor"])
+    events = []
+    for e in colls:
+        events.append({"ts": wall(e["ts"] + e.get("dur", 0.0)) + 0.05,
+                       "kind": "collective",
+                       "data": {"path": e["args"]["path"],
+                                "seq": e["args"]["seq"], "rank": 1,
+                                "nbytes": e["args"].get("nbytes"),
+                                "latency_ms": 1.0}})
+    peer = dict(aggregate._synthetic_dump(1, 0.0), events=events,
+                events_total=len(events))
+    p1 = tmp_path / "rank1.json"
+    p1.write_text(json.dumps(peer))
+    report, trace = aggregate.analyze([tracefile, str(p1)])
+    assert report["problems"] == []
+    assert report["cross_rank_flow_links"] >= 1
+    assert seq0 >= 0
+    # a rank's trace AND dump together are legitimate ('mixed freely'):
+    # they merge onto ONE track — no phantom rank, no self-match
+    own = dict(aggregate._synthetic_dump(0, 0.0), events=[
+        {"ts": wall(e["ts"] + e.get("dur", 0.0)), "kind": "collective",
+         "data": {"path": e["args"]["path"], "seq": e["args"]["seq"],
+                  "rank": 0, "latency_ms": e.get("dur", 0.0) / 1e3}}
+        for e in colls])
+    p0 = tmp_path / "rank0_dump.json"
+    p0.write_text(json.dumps(own))
+    report, trace = aggregate.analyze([tracefile, str(p0), str(p1)])
+    assert report["problems"] == []
+    assert sorted(report["ranks"]) == ["0", "1"]
+    assert len(report["ranks"]["0"]["sources"]) == 2
+    pids = {e["pid"] for e in trace["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert pids == {0, 1}
+    for row in report["stragglers"]:
+        assert sorted(row["ranks"]) == [0, 1]   # never rank 0 vs itself
+
+
+def test_cli_analyze_and_steps(tmp_path, capsys):
+    for rank in (0, 1):
+        (tmp_path / ("r%d.json" % rank)).write_text(
+            json.dumps(aggregate._synthetic_dump(rank, 0.1)))
+    merged = str(tmp_path / "merged.json")
+    rc = telemetry_main(["--analyze", str(tmp_path / "r0.json"),
+                         str(tmp_path / "r1.json"), "--merged", merged,
+                         "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    report = json.loads(out)
+    assert report["straggler_summary"]["worst_rank"] == 1
+    assert os.path.exists(merged)
+    rc = telemetry_main(["--analyze", str(tmp_path / "r0.json"),
+                         str(tmp_path / "r1.json")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "straggler table" in out and "worst rank: 1" in out
+
+
+def test_cli_steps_renders_live_ring(capsys):
+    rc = telemetry_main(["--steps", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["summary"]["steps"] == len(doc["steps"]) > 0
+    for rec in doc["steps"]:
+        total = sum(rec["components"].values())
+        assert total == pytest.approx(rec["wall_s"], abs=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# the 2-proc dist harness: a deliberately delayed rank must be named
+# ---------------------------------------------------------------------------
+
+_PRELUDE = textwrap.dedent("""
+    import os, sys, traceback
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+""")
+
+
+def _skipwrap(body):
+    return _PRELUDE + "try:\n" \
+        + textwrap.indent(textwrap.dedent(body), "    ") \
+        + textwrap.dedent("""
+            except Exception:
+                if "Multiprocess computations aren't implemented" \\
+                        in traceback.format_exc():
+                    print("SKIP-MULTIPROC", flush=True)
+                    os._exit(0)
+                raise
+        """)
+
+
+_LENS_WORKER = """
+    import time
+    from incubator_mxnet_tpu import autograd, gluon
+    from incubator_mxnet_tpu.telemetry import blackbox, lens
+
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == 2, nw
+    rs = np.random.RandomState(0)
+    ps = []
+    for k in range(8):
+        p = gluon.Parameter("p%%d" %% k, shape=(8, 8))
+        p.initialize(ctx=mx.cpu())
+        p.data()._write(rs.randn(8, 8).astype(np.float32))
+        ps.append(p)
+    t = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01}, kvstore=kv)
+    t._bucket_bytes_override = 1024
+    t._overlap_override = False      # serial reduces: enter times carry
+    #                                  the full straggler signal
+    for step in range(4):
+        if rank == 1:
+            time.sleep(0.2)          # rank 1 is the deliberate straggler
+        with autograd.record():
+            loss = None
+            for p in ps:
+                y = (p.data() * p.data()).sum()
+                loss = y if loss is None else loss + y
+        loss.backward()
+        t.step(1)
+    ps[-1].data().asnumpy()
+
+    # in-worker conservation check over the whole dist loop
+    recs = lens.steps()
+    assert len(recs) >= 4, recs
+    for r in recs:
+        total = sum(r["components"].values())
+        assert abs(total - r["wall_s"]) < 1e-6, (r["components"],
+                                                 r["wall_s"])
+    out = blackbox.dump(path=r"%(dir)s/lens_bb.rank%%d.json" %% rank,
+                        reason="manual")
+    assert out, "dump failed"
+    print("WORKER %%d LENS OK" %% rank, flush=True)
+"""
+
+
+def _launch_two(tmp_path, source, timeout=300, port_base=9900):
+    worker = tmp_path / "worker.py"
+    worker.write_text(source)
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.abspath(repo) + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    port = port_base + os.getpid() % 500
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "tools", "launch.py"),
+         "-n", "2", "-p", str(port), sys.executable, str(worker)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        proc.wait()
+        pytest.fail("2-process lens run deadlocked (%ds timeout)"
+                    % timeout)
+    out = stdout + stderr
+    if "SKIP-MULTIPROC" in out:
+        pytest.skip("backend lacks multiprocess CPU collectives")
+    assert proc.returncode == 0, out[-3000:]
+    return out
+
+
+def test_two_process_straggler_analysis(tmp_path):
+    """ISSUE-8 acceptance: train on the real 2-proc dist_sync wire with
+    rank 1 deliberately delayed, dump both flight recorders, and the
+    aggregator must name rank 1 in a schema-valid merged trace with
+    cross-rank flow links per reduced bucket."""
+    src = _skipwrap(_LENS_WORKER % {"dir": str(tmp_path)})
+    out = _launch_two(tmp_path, src, timeout=300)
+    assert "WORKER 0 LENS OK" in out and "WORKER 1 LENS OK" in out, \
+        out[-3000:]
+    p0 = tmp_path / "lens_bb.rank0.json"
+    p1 = tmp_path / "lens_bb.rank1.json"
+    assert p0.exists() and p1.exists()
+    merged = str(tmp_path / "merged.json")
+    report, trace = aggregate.analyze([str(p0), str(p1)],
+                                      merged_out=merged)
+    assert report["problems"] == []
+    assert ttracing.validate_chrome_trace(trace) == []
+    s = report["straggler_summary"]
+    assert s["worst_rank"] == 1, report["straggler_summary"]
+    assert s["max_enter_spread_s"] > 0.05
+    # every reduced bucket got a matched row + flow link
+    bucket_rows = [r for r in report["stragglers"]
+                   if str(r["label"]).startswith("bucket[")]
+    assert bucket_rows, report["stragglers"]
+    assert all(r["last_to_enter"] == 1 for r in bucket_rows)
+    assert report["cross_rank_flow_links"] >= len(bucket_rows)
+    pids = {e["pid"] for e in trace["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert pids == {0, 1}

@@ -18,6 +18,12 @@
 # 4. graftwatch smoke — telemetry --blackbox --selftest exercises the
 #    flight recorder end-to-end (engine flushes, kvstore collectives, a
 #    step journal, an in-flight bracket) and validates the dump schema.
+# 5. graftlens smoke — telemetry --analyze --selftest merges two
+#    synthetic rank dumps (rank 1 deliberately delayed) and requires a
+#    schema-valid merged trace with cross-rank flow links per reduced
+#    bucket plus a straggler table blaming rank 1; bench_eager --smoke
+#    (tier 3) additionally reports lens_overhead_pct against its < 2%
+#    budget (tracked in BENCH JSON, like blackbox_overhead_pct).
 #
 # Usage: tools/run_lint.sh [report.json]
 set -uo pipefail
@@ -29,5 +35,7 @@ python -m incubator_mxnet_tpu.analysis.graftlint --all --report "$REPORT" \
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_eager.py --smoke \
     || exit $?
 python -m incubator_mxnet_tpu.telemetry --blackbox --selftest \
+    || exit $?
+python -m incubator_mxnet_tpu.telemetry --analyze --selftest \
     || exit $?
 exec python -m incubator_mxnet_tpu.telemetry --selftest
