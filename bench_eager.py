@@ -186,6 +186,114 @@ def _overlap_step_bench(iters=12, repeats=4, n_params=FUSED_N_PARAMS,
     }
 
 
+def _duplex_step_bench(iters=12, repeats=3, n_params=FUSED_N_PARAMS,
+                       shape=FUSED_SHAPE, bucket_bytes=1 << 20):
+    """graftduplex (round 9): the 64-param dist_sync bench with the
+    store-side update (``update_on_kvstore=True`` — push applies the
+    server-semantics optimizer, pull broadcasts weights back), stepped
+    three ways on the same wire:
+
+    * ``serial``   — the whole handshake cold inside step(),
+    * ``overlap``  — PR 7 semantics: bucket reduces issued mid-backward
+      (grad-ready hooks), pulls still synchronous,
+    * ``duplex``   — reduces overlapped AND each bucket's weight pull an
+      async ``PullHandle`` waited at first touch in the NEXT forward.
+
+    Two views are reported: step-only latency (what step() still pays)
+    and whole-loop latency (the honest end-to-end number — the pull win
+    is a wait MOVED under the next forward, not merely relocated cost;
+    the loop ratio proves it was actually hidden).  Bit-parity across
+    all three is asserted before any number is reported, and the
+    pull-side exposed-wait delta (graft_trainer_pull_exposed_seconds)
+    shows the async pulls strictly below the synchronous-pull baseline."""
+    import jax.numpy as jnp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, telemetry
+
+    def build(prefix, overlap, pull):
+        rs = np.random.RandomState(0)
+        ps = []
+        for k in range(n_params):
+            p = gluon.Parameter("%s%d" % (prefix, k), shape=shape)
+            p.initialize(ctx=mx.cpu())
+            p.data()._write(jnp.asarray(rs.randn(*shape).astype(np.float32)))
+            ps.append(p)
+        t = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01},
+                          kvstore=mx.kv.create("dist_sync"),
+                          update_on_kvstore=True)
+        t._bucket_bytes_override = bucket_bytes
+        t._overlap_override = overlap
+        t._overlap_pull_override = pull
+        return ps, t
+
+    rs = np.random.RandomState(1)
+    consts = [mx.nd.array(rs.randn(*shape).astype(np.float32))
+              for _ in range(n_params)]
+
+    def train_round(params, trainer, n, timed):
+        step_s = 0.0
+        t_loop = time.perf_counter()
+        for _ in range(n):
+            with autograd.record():
+                loss = None
+                for p, c in zip(params, consts):
+                    y = (p.data() * p.data() * c).sum()
+                    loss = y if loss is None else loss + y
+            loss.backward()
+            t0 = time.perf_counter()
+            trainer.step(1)
+            if timed:
+                step_s += time.perf_counter() - t0
+        params[-1].data().asnumpy()              # sync (first-touch too)
+        return step_s / max(n, 1), (time.perf_counter() - t_loop) / max(n, 1)
+
+    cfgs = {"serial": (False, False), "overlap": (True, False),
+            "duplex": (True, True)}
+    runs, best_step, best_loop, pull_exposed = {}, {}, {}, {}
+    for name, (ov, pl) in cfgs.items():
+        runs[name] = build(name[:2], ov, pl)
+        train_round(*runs[name], n=2, timed=False)     # warm + arm
+        best_step[name] = best_loop[name] = float("inf")
+    for _ in range(repeats):
+        for name in cfgs:
+            snap0 = telemetry.compact_snapshot().get(
+                "graft_trainer_pull_exposed_seconds_sum", 0.0)
+            step_ms, loop_ms = train_round(*runs[name], n=iters, timed=True)
+            best_step[name] = min(best_step[name], step_ms)
+            best_loop[name] = min(best_loop[name], loop_ms)
+            pull_exposed[name] = telemetry.compact_snapshot().get(
+                "graft_trainer_pull_exposed_seconds_sum", 0.0) - snap0
+    ref = runs["serial"][0]
+    parity = all(
+        a.data().asnumpy().tobytes() == b.data().asnumpy().tobytes()
+        for name in ("overlap", "duplex")
+        for a, b in zip(ref, runs[name][0]))
+    assert parity, "full-duplex step diverged from the serial path"
+    snap = telemetry.compact_snapshot()
+    return {
+        "duplex_step_params": n_params,
+        "duplex_step_serial_ms": round(best_step["serial"] * 1e3, 3),
+        "duplex_step_overlap_ms": round(best_step["overlap"] * 1e3, 3),
+        "duplex_step_full_ms": round(best_step["duplex"] * 1e3, 3),
+        "duplex_loop_serial_ms": round(best_loop["serial"] * 1e3, 3),
+        "duplex_loop_overlap_ms": round(best_loop["overlap"] * 1e3, 3),
+        "duplex_loop_full_ms": round(best_loop["duplex"] * 1e3, 3),
+        "duplex_step_overlap_ratio": round(
+            best_step["overlap"] / best_step["serial"], 3),
+        "duplex_step_full_ratio": round(
+            best_step["duplex"] / best_step["serial"], 3),
+        "duplex_loop_full_ratio": round(
+            best_loop["duplex"] / best_loop["serial"], 3),
+        "duplex_step_parity": parity,
+        "duplex_pull_exposed_serial_s": round(
+            pull_exposed.get("serial", 0.0), 6),
+        "duplex_pull_exposed_full_s": round(
+            pull_exposed.get("duplex", 0.0), 6),
+        "duplex_pull_overlap_ratio": round(float(snap.get(
+            "graft_trainer_pull_overlap_ratio", 0.0)), 4),
+    }
+
+
 def _lens_overhead_bench(iters=20, repeats=4, n_params=8, shape=(16, 16)):
     """graftlens steady-state cost on a real train loop (record scope,
     backward, kvstore collectives, step journal — every lens source
@@ -292,6 +400,7 @@ def smoke():
     import jax
     res = _fused_step_bench(iters=3)
     res.update(_overlap_step_bench(iters=4, repeats=2))
+    res.update(_duplex_step_bench(iters=4, repeats=2))
     res.update(_blackbox_overhead_bench(iters=10, repeats=3))
     res.update(_lens_overhead_bench(iters=10, repeats=3))
     res["metric"] = "fused_step_smoke"
@@ -442,6 +551,9 @@ def main():
     # -- graftlap: overlapped vs serial bucketed step (round 7) ----------
     overlap = _overlap_step_bench(iters=ITERS // 2)
 
+    # -- graftduplex: full-duplex update_on_kvstore step (round 9) -------
+    duplex = _duplex_step_bench(iters=ITERS // 2)
+
     # -- graftwatch: flight-recorder overhead on the same 64-op chain ----
     blackbox_overhead = _blackbox_overhead_bench()
 
@@ -451,6 +563,7 @@ def main():
     print(json.dumps({
         **fused,
         **overlap,
+        **duplex,
         **blackbox_overhead,
         **lens_overhead,
         "metric": "eager_small_op_dispatch",

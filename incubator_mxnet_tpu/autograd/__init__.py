@@ -246,6 +246,14 @@ def _run_backward(heads, head_grads, retain_graph, train_mode, variables=None,
                         and inp._grad_req != "null":
                     seen.add(id(inp))
                     final_at.setdefault(k, []).append(inp)
+                    # graftduplex tape-order feedback: the earliest tape
+                    # position is where this input's gradient FINALIZES
+                    # on the reverse walk (higher = earlier).  The
+                    # Trainer's bucket packer sorts on it
+                    # (GRAFT_BUCKET_ORDER=tape) so first-to-finalize
+                    # params share the first buckets and their reduces
+                    # hit the wire earliest.
+                    inp._tape_pos = k
 
     for k in range(len(tape) - 1, -1, -1):
         node = tape[k]
@@ -284,9 +292,18 @@ def _run_backward(heads, head_grads, retain_graph, train_mode, variables=None,
             if g is None:
                 g = _zero_ct(v)
             results.append(g)
-    for node in tape:
+    for k, node in enumerate(tape):
         for arr in node.inputs:
-            _deliver(arr, grads, create_graph)
+            if _deliver(arr, grads, create_graph) and variables is None \
+                    and not create_graph:
+                # graftduplex tape-order feedback, the hook-less twin of
+                # the prescan stamp above: this forward-order sweep hits
+                # each delivered input at its EARLIEST tape position, so
+                # the very FIRST backward hands the Trainer's bucket
+                # packer its ordering — the first bucket plan is already
+                # tape-ordered and never rebuilds (a rebuild would
+                # abandon the transition step's in-flight reduces)
+                arr._tape_pos = k
     for h in heads:
         _deliver(h, grads, create_graph)
     if not retain_graph and not create_graph:
@@ -311,6 +328,9 @@ def _fire_ready_hook(arr):
 
 
 def _deliver(arr, grads, as_ndarray=False):
+    """Write one array's accumulated cotangent into its grad buffer.
+    Returns True when a delivery actually happened (the caller's
+    forward-order sweep stamps ``_tape_pos`` off it)."""
     if arr._grad is not None and arr._grad_req != "null" and id(arr) in grads:
         g = grads[id(arr)]
         if as_ndarray:
@@ -320,6 +340,8 @@ def _deliver(arr, grads, as_ndarray=False):
         else:
             arr._grad._write(jnp.asarray(g, arr._grad._read().dtype))
         grads.pop(id(arr))
+        return True
+    return False
 
 
 def _recorded_vjp(node, ct_nds):

@@ -97,7 +97,7 @@ from .telemetry import tracing as _ttracing
 __all__ = ["bulk", "offband", "flush", "flush_stats", "reset_flush_stats",
            "EngineHazardError", "engine_check_enabled", "set_engine_check",
            "BoundedCache", "cache_sizes", "flatten_arrays", "unflatten",
-           "split_flat"]
+           "split_flat", "colocate"]
 
 
 # --- strict-mode switch (GRAFT_ENGINE_CHECK=1) -----------------------------
@@ -869,3 +869,24 @@ def split_flat(flat, shapes):
         fn = jax.jit(lambda f: unflatten(f, shapes))
         _split_cache[key] = fn
     return fn(flat)
+
+
+def colocate(val, ref):
+    """``val`` on ``ref``'s committed device (a no-op when they already
+    share one, or when placement cannot be determined).
+
+    The committed-device-safe glue for multi-context replica math: a
+    context list like ``[cpu(0) .. cpu(7)]`` commits each replica to its
+    own jax device, and jax refuses elementwise ops (and jit calls) that
+    mix arrays committed to different devices — so every cross-context
+    tree-sum, flat-bucket broadcast and store→replica pull must move the
+    operand first.  Transfers preserve bits, so the bit-parity contracts
+    of the fused/overlapped step paths are unaffected."""
+    try:
+        vd = val.devices()
+        rd = ref.devices()
+    except Exception:
+        return val          # tracers / non-jax values carry no placement
+    if vd == rd or len(rd) != 1:
+        return val
+    return jax.device_put(val, next(iter(rd)))

@@ -6,6 +6,18 @@ pipeline keeps augmentation on host CPU in a thread pool — numpy transforms
 release the GIL, jax.device_put overlaps H2D with compute — and hands the
 device exactly one ready batch ahead (double-buffering, the same effect the
 reference's prefetcher iterators achieve: src/io/iter_prefetcher.h).
+
+graftduplex prefetch-to-device (GRAFT_PREFETCH_DEVICE, default on): each
+lookahead batch's host→device transfer is ISSUED on the worker thread
+under ``engine.offband()`` the moment the batch is built
+(``io.issue_device_prefetch`` — the same issue/wait split ``ReduceHandle``
+gave the gradient wire), so batch N+1's bytes stream to the device while
+batch N computes.  With ``num_workers=0`` the loader now runs the same
+one-batch-lookahead pipeline on a single pool thread (batches stay
+sequential and in order — the reference's prefetcher iterators thread the
+"synchronous" path the same way, iter_prefetcher.h); set
+``GRAFT_PREFETCH_DEVICE=0`` or ``prefetch_device=False`` for the strictly
+consumer-thread behavior.
 """
 from __future__ import annotations
 
@@ -13,6 +25,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from ...io import device_prefetch_enabled, issue_device_prefetch
 from ...ndarray import NDArray
 from ... import ndarray as _nd
 from .sampler import BatchSampler, RandomSampler, SequentialSampler, Sampler
@@ -36,8 +49,9 @@ class DataLoader(object):
 
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
-                 num_workers=0):
+                 num_workers=0, prefetch_device=None):
         self._dataset = dataset
+        self._prefetch_device = prefetch_device     # None = GRAFT_PREFETCH_DEVICE
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError("batch_size must be specified unless "
@@ -71,7 +85,7 @@ class DataLoader(object):
         exactly when the next epoch's first batches were needed."""
         if self._pool is None:
             self._pool = ThreadPoolExecutor(
-                max_workers=self._num_workers,
+                max_workers=max(1, self._num_workers),
                 thread_name_prefix="graft-dataloader")
         return self._pool
 
@@ -92,7 +106,8 @@ class DataLoader(object):
     def __iter__(self):
         import time as _time
         from ...telemetry import lens as _lens
-        if self._num_workers == 0:
+        prefetch = device_prefetch_enabled(self._prefetch_device)
+        if self._num_workers == 0 and not prefetch:
             for batch in self._batch_sampler:
                 # synchronous batch production IS the consumer's wait:
                 # the whole load+batchify lands on graftlens' data_wait
@@ -102,11 +117,21 @@ class DataLoader(object):
                 _lens.io_wait(t0, _time.perf_counter())
                 yield out
             return
-        # thread-pool pipeline with one-batch lookahead (double buffering)
+        # thread-pool pipeline with one-batch lookahead (double
+        # buffering); num_workers=0 + device prefetch runs the same
+        # pipeline on ONE thread — batches stay sequential and ordered,
+        # but batch N+1 builds (and its H2D issues) under batch N's
+        # compute instead of under the consumer's wait
         pool = self._worker_pool()
 
         def make(batch):
-            return self._batchify_fn([self._dataset[idx] for idx in batch])
+            out = self._batchify_fn([self._dataset[idx] for idx in batch])
+            if prefetch:
+                # the lookahead batch's host→device transfer goes on the
+                # wire NOW, from the worker thread (engine.offband keeps
+                # any open bulk segment on this thread untouched)
+                issue_device_prefetch(out)
+            return out
         futures = []
         it = iter(self._batch_sampler)
         depth = max(2, self._num_workers)

@@ -6,278 +6,73 @@ push/pull or update_on_kvstore); on a device mesh the same step lowers to
 psum-over-ICI via the parallel package instead of Comm/NCCL reductions.
 
 graftfuse (the bucketed step path): ``step`` no longer walks parameters
-one at a time.  Dense float parameters are greedily packed — in index
-order, per dtype — into flat buckets of ~``GRAFT_BUCKET_BYTES`` (default
-4 MiB); each bucket's gradients are concatenated into ONE buffer, reduced
-across contexts as one elementwise tree-sum and across workers as one
+one at a time.  Dense float parameters are greedily packed — per dtype,
+in tape or index order (``GRAFT_BUCKET_ORDER``, see ``_plan_order``) —
+into flat buckets of ~``GRAFT_BUCKET_BYTES`` (default 4 MiB); each
+bucket's gradients are concatenated into ONE buffer, reduced across
+contexts as one elementwise tree-sum and across workers as one
 collective (``KVStore.reduce_many`` → ``_cross_worker_reduce_many``), and
 applied through ONE jitted multi-tensor optimizer program per
 (optimizer-class, bucket signature) — ``optimizer.fused_bucket_update``.
 The whole step stays on device (no ``_read()`` round trips between reduce
 and update) and is bit-identical to the per-param path (the fused program
 runs the same registered op formulas element-for-element).  Per-param
-fallbacks: ``update_on_kvstore``, ``ignore_stale_grad``, gradient
-compression, store-side updaters, sparse grads, and optimizers without a
-fused kernel (anything but exact SGD/Adam).  One behavioral delta on the
-fused path: reduced gradients are consumed directly by the update and are
-NOT written back into ``param.list_grad()`` (``allreduce_grads()`` — the
-grad-accumulation API — keeps exact per-key write-back semantics).
+fallbacks: ``ignore_stale_grad``, gradient compression, sparse grads, and
+optimizers without a fused kernel (anything but exact SGD/Adam).  One
+behavioral delta on the fused path: reduced gradients are consumed
+directly by the update and are NOT written back into
+``param.list_grad()`` (``allreduce_grads()`` — the grad-accumulation API
+— keeps exact per-key write-back semantics).
+
+graftlap (PR 7) moved each bucket's reduce ISSUE into the backward pass:
+``overlap.BucketScheduler`` arms grad-ready hooks at the end of every
+bucketed step, the next backward delivers each parameter's gradient the
+moment it finalizes, and complete buckets ship through
+``KVStore.reduce_many_async`` while the walk continues — ``step()`` only
+waits.
+
+graftduplex (PR 9) finishes the wire: the ``update_on_kvstore`` path —
+previously 100% serial — gets its own bucket plan (``_duplex_plan``):
+bucket reduces ride the same grad-ready hooks mid-backward, the
+store-side optimizer applies each bucket's split pieces
+(``KVStore.apply_reduced``), and each bucket's weight pull goes straight
+back on the wire as a ``PullHandle`` (``KVStore.pull_many_async``)
+waited at FIRST USE in the next forward (``overlap.PullScheduler``
+first-touch hooks) — the step is full-duplex: gradients stream out
+under backward while updated weights stream back under data loading and
+the next forward's early layers.  Serial fallbacks mirror the reduce
+side: ``GRAFT_OVERLAP_PULL=0``, a stale (user-overwritten) weight
+between steps, compression, sparse params; the dist_async parameter
+service keeps per-group async pulls (background-thread RPC) without the
+bucket plan.
 """
 from __future__ import annotations
 
 import os
 import time
-import weakref
 
 import numpy as np
 
 from .. import engine as _engine
 from .. import optimizer as opt
+from .. import overlap as _overlap
 from .parameter import ParameterDict, Parameter
 
 __all__ = ["Trainer"]
 
-_DEFAULT_BUCKET_BYTES = 4 << 20      # 4 MiB, the classic DDP bucket size
+_DEFAULT_BUCKET_BYTES = _overlap.DEFAULT_BUCKET_BYTES
 
-
-class _Bucket(object):
-    """One (dtype, state-arity)-homogeneous gradient bucket of the fused
-    step plan."""
-    __slots__ = ("indices", "kind", "dtype", "nbytes")
-
-    def __init__(self, indices, kind, dtype, nbytes):
-        self.indices = tuple(indices)
-        self.kind = kind
-        self.dtype = dtype
-        self.nbytes = nbytes
-
-
-class _BucketScheduler(object):
-    """graftlap: issue each bucket's gradient allreduce DURING backward.
-
-    Armed by ``Trainer.step`` with the current fused plan, the scheduler
-    hangs a grad-ready hook on every eligible parameter's data arrays
-    (autograd fires it the moment that parameter's gradient is final —
-    see ``autograd._run_backward``).  When the last (param, context) pair
-    of a bucket reports ready, the bucket's concatenated flat gradient is
-    built with the EXACT serial-path math (``Trainer._bucket_flat``) and
-    shipped through ``KVStore.reduce_many_async`` — an in-flight handle
-    with its own flight-recorder bracket — while backward keeps producing
-    earlier-layer gradients.  ``Trainer.step`` then only *waits* on the
-    handles.  Because the hook order is the reverse-topological walk of a
-    tape every rank shares (SPMD), the issue order of the collectives is
-    identical on every worker: the lockstep contract holds.
-
-    Safety rails (each one degrades to the serial PR-4 reduce, never to
-    wrong values):
-
-    * hooks fire only on a plain full backward — ``retain_graph``,
-      ``create_graph`` and explicit-variables passes suppress them;
-    * a hook under a NEW ``autograd.backward_pass_id()`` abandons every
-      handle of the previous pass before scheduling restarts (a second
-      backward overwrote the reduced grads);
-    * only buckets whose params all have ``grad_req == "write"`` are
-      eligible ("add" accumulation means grads are not final per pass);
-    * at consume time every grad's ``_version`` must still match its
-      issue-time stamp (gradient clipping or any other post-backward
-      mutation invalidates the handle);
-    * a scheduler exception marks it broken for the step instead of
-      propagating into the user's backward.
-    """
-
-    __slots__ = ("_trainer_ref", "_armed", "_waiting", "_hooked",
-                 "_buckets", "_pass_id", "_broken", "_plan", "_hook",
-                 "issued_total", "taken_total", "__weakref__")
-
-    def __init__(self, trainer):
-        self._trainer_ref = weakref.ref(trainer)
-        # ONE hook closure, created once (`self._on_ready` builds a fresh
-        # bound method per attribute access, so ad-hoc accessors would
-        # never pass disarm's identity check and hooks would leak), and
-        # holding the scheduler WEAKLY: a bound method would pin the
-        # scheduler — and through nothing else, the arrays its hooks sit
-        # on — alive long after the Trainer is dropped, keeping the
-        # autograd hook-source gate open forever.  With the weakref the
-        # scheduler dies with its Trainer; orphaned hook attrs left on
-        # param arrays degrade to a dead-ref no-op until overwritten.
-        sched_ref = weakref.ref(self)
-
-        def _hook(arr, _ref=sched_ref):
-            sched = _ref()
-            if sched is not None:
-                sched._on_ready(arr)
-        self._hook = _hook
-        self._armed = False
-        self._waiting = {}      # id(data NDArray) -> (bucket state, i, j)
-        self._hooked = []       # data NDArrays carrying our hook
-        self._buckets = {}      # id(bucket) -> state dict
-        self._pass_id = None
-        self._broken = False
-        self._plan = None       # the armed plan, held STRONGLY: identity
-        #                         (same cached tuple) means same plan, and
-        #                         the ref pins it so a recycled id() can
-        #                         never alias a new plan
-        self.issued_total = 0   # buckets issued mid-backward (ever)
-        self.taken_total = 0    # issued buckets actually consumed by step
-
-    # -- arming -------------------------------------------------------------
-    def arm(self, plan):
-        """Install hooks for ``plan``'s eligible buckets (called at the
-        end of every overlapped step, so the NEXT backward schedules).
-        Steady state — same (cached) plan object, scheduler healthy —
-        skips the reinstall: the next backward's first hook resets the
-        pending sets via the pass-id rollover, so re-arming is O(1)."""
-        if self._armed and not self._broken and self._plan is plan:
-            self._abandon_all()
-            for state in self._buckets.values():
-                state["handle"] = None
-                state["flat"] = None
-            self._pass_id = None    # next hook rebuilds pending sets
-            return
-        self.disarm()
-        trainer = self._trainer_ref()
-        if trainer is None:
-            return
-        buckets, _leftover = plan
-        for b in buckets:
-            if any(trainer._params[i].grad_req != "write"
-                   for i in b.indices):
-                continue        # "add" accumulation: never final per pass
-            state = {"bucket": b, "pending": set(), "handle": None,
-                     "flat": None, "versions": None, "grads": []}
-            for i in b.indices:
-                grads = trainer._params[i].list_grad()
-                for j, d in enumerate(trainer._params[i].list_data()):
-                    state["pending"].add((i, j))
-                    state["grads"].append(grads[j])
-                    self._waiting[id(d)] = (state, i, j)
-                    d._grad_ready_hook = self._hook
-                    self._hooked.append(d)
-            if state["pending"]:
-                self._buckets[id(b)] = state
-        self._armed = bool(self._buckets)
-        if self._armed:
-            from .. import autograd
-            autograd.register_hook_source(self)
-        self._plan = plan if self._armed else None
-        self._pass_id = None
-        self._broken = False
-
-    def disarm(self):
-        """Drop hooks and abandon anything still in flight."""
-        for d in self._hooked:
-            if getattr(d, "_grad_ready_hook", None) is self._hook:
-                d._grad_ready_hook = None
-        self._hooked = []
-        self._waiting = {}
-        self._abandon_all()
-        self._buckets = {}
-        self._armed = False
-        self._plan = None
-        from .. import autograd
-        autograd.unregister_hook_source(self)
-
-    def _abandon_all(self):
-        for state in self._buckets.values():
-            if state["handle"] is not None:
-                state["handle"].abandon()
-                state["handle"] = None
-
-    # -- the hook (fires inside autograd._run_backward) ---------------------
-    def _on_ready(self, arr):
-        if not self._armed or self._broken:
-            return
-        if self._trainer_ref() is None:
-            # the Trainer is gone but something still holds the scheduler
-            # (a kept `t._scheduler` ref): clean up after ourselves
-            self.disarm()
-            return
-        try:
-            from .. import autograd
-            pass_id = autograd.backward_pass_id()
-            if pass_id != self._pass_id:
-                # new backward pass: everything issued for the previous
-                # one reduces grads that were just overwritten — discard
-                # and start this pass clean
-                n_ctx = self._ctx_count()
-                self._abandon_all()
-                for state in self._buckets.values():
-                    state["pending"] = {(i, j)
-                                        for i in state["bucket"].indices
-                                        for j in range(n_ctx)}
-                self._pass_id = pass_id
-            entry = self._waiting.get(id(arr))
-            if entry is None:
-                return
-            state, i, j = entry
-            state["pending"].discard((i, j))
-            if not state["pending"] and state["handle"] is None:
-                self._issue(state)
-        except Exception:
-            self._broken = True
-            self._abandon_all()
-            raise               # _fire_ready_hook catches + logs; the
-            #                     user's backward pass is unaffected
-
-    def _ctx_count(self):
-        trainer = self._trainer_ref()
-        return len(trainer._contexts) if trainer is not None else 0
-
-    def _issue(self, state):
-        """All grads of one bucket are final: build the flat buffer and
-        put its reduce on the wire, without joining (or flushing) any
-        bulk segment the surrounding code has open."""
-        trainer = self._trainer_ref()
-        if trainer is None:
-            return
-        kv = trainer._kvstore_obj
-        if kv is None:
-            return
-        b = state["bucket"]
-        with _engine.offband():
-            flat = trainer._bucket_flat(b)
-            state["versions"] = [g._version for g in state["grads"]]
-            state["flat"] = flat
-            state["handle"] = kv.reduce_many_async(
-                [flat], label="bucket[%s:%dp:%dB]" % (
-                    np.dtype(b.dtype).name, len(b.indices), b.nbytes))
-        self.issued_total += 1
-
-    # -- consuming (Trainer.step) -------------------------------------------
-    def take(self, plan):
-        """Hand the step the buckets whose reduces are validly in flight:
-        ``{id(bucket): (flat NDArray, ReduceHandle)}``.  Stale handles
-        (grad versions moved since issue) are abandoned; everything is
-        one-shot — the caller re-arms for the next step."""
-        trainer = self._trainer_ref()
-        out = {}
-        if trainer is None or not self._armed or self._broken:
-            self._abandon_all()
-            return out
-        buckets, _leftover = plan
-        by_id = {id(b): b for b in buckets}
-        for bid, state in self._buckets.items():
-            handle = state["handle"]
-            if handle is None:
-                continue
-            b = by_id.get(bid)
-            if b is None:
-                handle.abandon()        # plan changed under us
-                continue
-            if [g._version for g in state["grads"]] != state["versions"]:
-                handle.abandon()        # stale grads: serial fallback
-                continue
-            out[bid] = (state["flat"], handle)
-            state["handle"] = None      # consumed
-        self.taken_total += len(out)
-        return out
+# back-compat aliases: the bucket/scheduler types moved to overlap.py so
+# Module can ride the same machinery (graftduplex)
+_Bucket = _overlap.Bucket
+_BucketScheduler = _overlap.BucketScheduler
 
 
 class Trainer(object):
     """ref: gluon/trainer.py class Trainer."""
 
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None):
+                 compression_params=None, update_on_kvstore=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -298,7 +93,15 @@ class Trainer(object):
         self._init_optimizer(optimizer, optimizer_params)
         self._kv_initialized = False
         self._kvstore = kvstore
+        # reference parity (trainer.py update_on_kvstore kwarg): None =
+        # auto (store type decides), True/False forces — the switch that
+        # selects between the local fused update and the store-side
+        # (server-semantics) update the duplex path overlaps
+        self._update_on_kvstore_arg = update_on_kvstore
         self._scheduler = _BucketScheduler(self)
+        self._pull_scheduler = _overlap.PullScheduler()
+        self._bucket_lateness = {}      # param idx -> blocked-wait EWMA
+        #                                 (tape-order packing tie-breaker)
 
     def _check_contexts(self):
         contexts = None
@@ -342,6 +145,15 @@ class Trainer(object):
                 # every push with the server-side optimizer and pulls
                 # return weights (kvstore_dist_server.h async mode)
                 update_on_kvstore = "async" in kvstore.type
+            if self._update_on_kvstore_arg is not None:
+                # explicit user choice (reference trainer.py kwarg);
+                # dist_async cannot update locally — its weights live on
+                # the parameter server (same reference restriction)
+                if "async" in kvstore.type \
+                        and not self._update_on_kvstore_arg:
+                    raise ValueError(
+                        "Cannot set update_on_kvstore=False on dist_async")
+                update_on_kvstore = bool(self._update_on_kvstore_arg)
             # one batched init: on dist stores this is a single rank-0
             # broadcast collective for all params, not one per key
             kvstore.init(list(range(len(self._params))),
@@ -386,18 +198,29 @@ class Trainer(object):
         self._optimizer.rescale_grad = self._scale / batch_size
         if not self._kv_initialized:
             self._init_kvstore()
-        plan = None if ignore_stale_grad else self._fused_plan()
+        if ignore_stale_grad:
+            plan = None
+        elif self._update_on_kvstore:
+            plan = self._duplex_plan()      # store-side update: duplex
+        else:
+            plan = self._fused_plan()       # local fused update
         from ..telemetry import blackbox as _blackbox
         from ..telemetry import tracing as _ttracing
         # graftwatch step journal: one flight-recorder event per step
         # with kvstore/update phase latencies + device-memory highwater;
         # a crash or hang mid-step names the phase it stopped in
         overlap = plan is not None and self._overlap_enabled() \
-            and not self._update_on_kvstore and self._kvstore_obj is not None
+            and self._kvstore_obj is not None
+        duplex = self._update_on_kvstore and plan is not None
         with _blackbox.step_journal("trainer", batch_size=batch_size,
                                     fused=plan is not None,
-                                    overlapped=overlap):
+                                    overlapped=overlap, duplex=duplex):
             with _ttracing.phase_span("kvstore"):
+                # settle last step's in-flight weight pulls FIRST: an
+                # out array rides one handle at a time, and a stale
+                # (user-overwritten) weight downgrades THIS round's
+                # pulls to the serial path (abandon-and-fallback)
+                pull_stale = self._pull_scheduler.finish()
                 if plan is None:
                     self._scheduler.disarm()
                     self._allreduce_grads()
@@ -405,7 +228,10 @@ class Trainer(object):
                     reduced = self._bucketed_allreduce(plan)
             with _ttracing.phase_span("update"):
                 if plan is None:
-                    self._update(ignore_stale_grad)
+                    self._update(ignore_stale_grad,
+                                 pull_stale=pull_stale)
+                elif duplex:
+                    self._duplex_store_update(plan, reduced, pull_stale)
                 else:
                     self._bucketed_update(plan, reduced)
         # graftlap: (re-)arm the grad-ready hooks so the NEXT backward
@@ -449,13 +275,14 @@ class Trainer(object):
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 
-    def _update(self, ignore_stale_grad=False):
+    def _update(self, ignore_stale_grad=False, pull_stale=None):
         if self._kvstore_obj is not None and self._update_on_kvstore:
+            if pull_stale is None:      # direct update() call: settle
+                pull_stale = self._pull_scheduler.finish()
             keys = [i for i, p in enumerate(self._params)
                     if p.grad_req != "null"]
             if keys:
-                self._kvstore_obj.pull_many(
-                    keys, [self._params[i].list_data() for i in keys])
+                self._pull_weights(keys, stale=pull_stale)
             return
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
@@ -463,6 +290,28 @@ class Trainer(object):
             for upd, arr, grad in zip(self._updaters, param.list_data(),
                                       param.list_grad()):
                 upd(i, grad, arr)
+
+    def _pull_overlap_ok(self, keys, stale):
+        """Async pulls for this round?  ``stale`` > 0 (a weight the user
+        overwrote while its pull was in flight) forces one serial round —
+        the abandon-and-fallback rail; sparse params always pull
+        serially."""
+        return self._overlap_pull_enabled() and not stale \
+            and all(self._params[i]._stype == "default" for i in keys)
+
+    def _pull_weights(self, keys, stale=0):
+        """Bring updated weights back from the store for ``keys`` —
+        async per ~bucket-size group with first-touch waits when the
+        duplex pull side is on (graftduplex; the dist_async parameter
+        service lands here and overlaps its pull RPC on a background
+        thread), the synchronous ``pull_many`` otherwise."""
+        _overlap.pull_round(
+            self._pull_scheduler, self._kvstore_obj, keys,
+            [self._params[i].list_data() for i in keys],
+            [int(np.prod(self._params[i].shape))
+             * np.dtype(self._params[i].dtype).itemsize for i in keys],
+            self._bucket_target_bytes(),
+            self._pull_overlap_ok(keys, stale))
 
     # -- graftfuse: the bucketed step path ---------------------------------
     _bucket_bytes_override = None     # tests/benches force a target here
@@ -486,6 +335,159 @@ class Trainer(object):
             return bool(self._overlap_override)
         return os.environ.get("GRAFT_OVERLAP", "1").strip().lower() \
             not in ("0", "false", "no", "off")
+
+    _overlap_pull_override = None     # tests/benches force pull overlap
+
+    def _overlap_pull_enabled(self):
+        """GRAFT_OVERLAP_PULL (default on): overlap the store→worker
+        weight pulls with the next forward (graftduplex).  Same
+        rank-consistency contract as GRAFT_OVERLAP."""
+        return _overlap.overlap_pull_enabled(self._overlap_pull_override)
+
+    # -- overlap.BucketScheduler host protocol ------------------------------
+    _sched_autograd_hooks = True      # hooks delivered by autograd's walk
+
+    def _sched_entries(self, b):
+        out = []
+        for i in b.indices:
+            grads = self._params[i].list_grad()
+            for j, d in enumerate(self._params[i].list_data()):
+                out.append(((i, j), d, grads[j]))
+        return out
+
+    def _sched_eligible(self, b):
+        return all(self._params[i].grad_req == "write" for i in b.indices)
+
+    def _sched_kv(self):
+        return self._kvstore_obj
+
+    def _sched_flat(self, b):
+        return self._bucket_flat(b)
+
+    def _sched_pass_id(self):
+        from .. import autograd
+        return autograd.backward_pass_id()
+
+    def _sched_label(self, b):
+        return "bucket[%s:%dp:%dB]" % (np.dtype(b.dtype).name,
+                                       len(b.indices), b.nbytes)
+
+    def _plan_order(self):
+        """Parameter iteration order for bucket packing:
+        ``(mode, sig_perm, build_perm)``.
+
+        ``GRAFT_BUCKET_ORDER=tape`` (default) sorts parameters by
+        DESCENDING earliest-tape-position (``autograd`` stamps
+        ``_tape_pos`` on each hooked data array during the backward
+        prescan): the reverse walk finalizes high positions first, so
+        first-to-finalize params share the first buckets and their
+        reduces hit the wire earliest — the overlap window covers more
+        of backward (today's index packing often closes the last bucket
+        only at end-of-walk).  Parameters without a stamp yet (first
+        steps, hook-ineligible) pack after the stamped ones in index
+        order.  Ties (params finalized by the same tape node) break on
+        the per-param blocked-wait EWMA the step feeds back
+        (``_bucket_lateness``, quantized to ms): systematically late
+        params pack earlier.  The lateness tie-break applies ONLY when a
+        plan is being (re)built — ``sig_perm`` (tape positions + index)
+        is what the plan cache keys on, so EWMA drift can never
+        invalidate a cached plan and trigger the serial fallback step a
+        rebuild costs; a rebuild for a real reason (tape change, shape
+        change) picks up the latest lateness.
+        ``GRAFT_BUCKET_ORDER=index`` reverts to plain index packing."""
+        n = len(self._params)
+        mode = _overlap.bucket_order()
+        if mode != "tape":
+            perm = tuple(range(n))
+            return ("index", perm, perm)
+        pos = []
+        for p in self._params:
+            d = None
+            if p._data is not None:
+                try:
+                    d = p.list_data()[0]
+                except Exception:
+                    d = None
+            pos.append(None if d is None
+                       else getattr(d, "_tape_pos", None))
+        late = self._bucket_lateness
+
+        def _key(i, with_lateness):
+            tp = pos[i]
+            if tp is None:
+                return (1, 0, 0, i)
+            lateness = -int(round(late.get(i, 0.0) * 1e3)) \
+                if with_lateness else 0
+            return (0, -tp, lateness, i)
+
+        sig_perm = tuple(sorted(range(n), key=lambda i: _key(i, False)))
+        build_perm = tuple(sorted(range(n), key=lambda i: _key(i, True)))
+        return ("tape", sig_perm, build_perm)
+
+    def _note_bucket_lateness(self, b, blocked_s):
+        """Feed one overlapped bucket's blocked wait back into the
+        packing tie-breaker (0.8/0.2 EWMA, the straggler convention)."""
+        for i in b.indices:
+            prev = self._bucket_lateness.get(i)
+            self._bucket_lateness[i] = blocked_s if prev is None \
+                else 0.8 * prev + 0.2 * blocked_s
+
+    def _duplex_plan(self):
+        """The bucket plan for the update_on_kvstore (store-side update)
+        path, or None when step() must stay on the serial per-key wire.
+
+        Unlike ``_fused_plan`` the optimizer needs no fused kernel — the
+        update runs store-side via ``KVStore.apply_reduced`` with the
+        exact per-key updater — so buckets group by dtype alone.
+        Fallbacks: no store, compression (the per-key push quantizes at
+        key granularity — a flat reduce would change the algebra), the
+        dist_async parameter service (pushes must ride the PS RPC; its
+        PULLS still overlap via ``_pull_weights``), sparse params, and
+        unknown shapes."""
+        target = self._bucket_target_bytes()
+        kv = self._kvstore_obj
+        if target <= 0 or kv is None or not self._update_on_kvstore \
+                or kv._compressor is not None \
+                or getattr(kv, "_ps", None) is not None:
+            return None
+        order_mode, sig_perm, perm = self._plan_order()
+        sig = ("duplex", target, order_mode, sig_perm,
+               len(self._contexts),
+               tuple((str(p.dtype), p.shape, p.grad_req, p._stype,
+                      p._grad_stype) for p in self._params))
+        cached = getattr(self, "_duplex_plan_cache", None)
+        if cached is not None and cached[0] == sig:
+            return cached[1]
+        open_buckets = {}       # dtype -> (indices, nbytes)
+        buckets, leftover = [], []
+        for i in perm:
+            p = self._params[i]
+            if p.grad_req == "null":
+                continue
+            dense = p._stype == "default" and p._grad_stype == "default"
+            known = p.shape is not None and int(np.prod(p.shape)) > 0
+            if not dense or not known:
+                leftover.append(i)
+                continue
+            dt = np.dtype(p.dtype)
+            nbytes = int(np.prod(p.shape)) * dt.itemsize
+            idxs, total = open_buckets.setdefault(dt, ([], 0))
+            idxs.append(i)
+            total += nbytes
+            if total >= target:
+                buckets.append(_Bucket(idxs, None, dt, total))
+                open_buckets.pop(dt)
+            else:
+                open_buckets[dt] = (idxs, total)
+        for dt, (idxs, total) in open_buckets.items():
+            buckets.append(_Bucket(idxs, None, dt, total))
+        plan = (buckets, leftover) if buckets else None
+        self._duplex_plan_cache = (sig, plan)
+        if plan is not None:
+            from ..telemetry import metrics as _tmetrics
+            _tmetrics.trainer_buckets([b.nbytes for b in buckets],
+                                      len(leftover))
+        return plan
 
     def _fused_plan(self):
         """The bucket plan for the current configuration, or None when
@@ -513,9 +515,10 @@ class Trainer(object):
             arities.append(None if kind is None else (
                 opt.fused_state_arity(optimizer, kind, states0[i])
                 if i in states0 else opt.fused_state_arity(optimizer, kind)))
+        order_mode, sig_perm, perm = self._plan_order()
         sig = (target, type(optimizer), bool(optimizer.multi_precision),
                getattr(optimizer, "momentum", None), tuple(arities),
-               len(self._contexts), kv is not None,
+               len(self._contexts), kv is not None, order_mode, sig_perm,
                tuple((str(p.dtype), p.shape, p.grad_req, p._stype,
                       p._grad_stype) for p in self._params))
         cached = getattr(self, "_fused_plan_cache", None)
@@ -523,7 +526,8 @@ class Trainer(object):
             return cached[1]
         open_buckets = {}       # (dtype, arity) -> (indices, nbytes)
         buckets, leftover = [], []
-        for i, p in enumerate(self._params):
+        for i in perm:
+            p = self._params[i]
             if p.grad_req == "null":
                 continue
             kind = kinds[i]
@@ -555,21 +559,16 @@ class Trainer(object):
         return plan
 
     def _bucket_flat(self, b):
-        """One bucket's concatenated local gradient: per-context flatten
-        (one jitted dispatch each) + elementwise context tree-sum in
-        context order — THE packing math, shared verbatim by the serial
-        step path and the overlapped mid-backward issue so the two are
-        bit-identical by construction."""
-        from ..ndarray import NDArray
-        per_ctx = [
-            _engine.flatten_arrays(tuple(
-                self._params[i].list_grad()[j]._read()
-                for i in b.indices))
-            for j in range(len(self._contexts))]
-        acc = per_ctx[0]
-        for f in per_ctx[1:]:
-            acc = acc + f
-        return NDArray(acc, ctx=self._contexts[0])
+        """One bucket's concatenated local gradient — delegates to the
+        shared ``overlap.concat_ctx_sum`` packing math (per-context
+        flatten + committed-device-safe elementwise tree-sum in context
+        order), used verbatim by the serial step path, the overlapped
+        mid-backward issue AND Module's bucketed reduce so all of them
+        are bit-identical by construction."""
+        return _overlap.concat_ctx_sum(
+            [[self._params[i].list_grad()[j] for i in b.indices]
+             for j in range(len(self._contexts))],
+            ctx=self._contexts[0])
 
     def _bucketed_allreduce(self, plan):
         """Reduce every bucket's gradients with ONE concatenated buffer
@@ -590,10 +589,14 @@ class Trainer(object):
         if kv is not None and leftover:
             grads = [self._params[i].list_grad() for i in leftover]
             kv.push_many(leftover, grads)
-            kv.pull_many(leftover, grads)
+            if not self._update_on_kvstore:
+                kv.pull_many(leftover, grads)
+            # update_on_kvstore: the push applied the store-side update;
+            # _duplex_store_update pulls the WEIGHTS back (pulling into
+            # the grads here would clobber them with weight bytes)
         if kv is None:
             return {}
-        overlap = self._overlap_enabled() and not self._update_on_kvstore
+        overlap = self._overlap_enabled()
         issued = self._scheduler.take(plan) if overlap else {}
         serial = [b for b in buckets if id(b) not in issued]
         flats = {id(b): self._bucket_flat(b) for b in serial}
@@ -611,6 +614,7 @@ class Trainer(object):
             t1 = time.perf_counter()
             exposed_s += t1 - t0
             inflight_s += t1 - handle.issued_at
+            self._note_bucket_lateness(b, t1 - t0)
             reduced[id(b)] = flat
         if overlap:
             if issued:
@@ -626,6 +630,48 @@ class Trainer(object):
             _tmetrics.trainer_overlap(len(issued), len(serial),
                                       exposed_s, inflight_s)
         return reduced
+
+    def _duplex_store_update(self, plan, reduced, pull_stale=0):
+        """The store-side half of the full-duplex step: split each
+        bucket's reduced flat into per-key pieces, run the EXACT per-key
+        store updater on them (``KVStore.apply_reduced`` — the same
+        formula ``push`` would have applied, minus the second reduce),
+        and put THAT bucket's weight pull straight back on the wire
+        (``_pull_weights`` with the bucket as its own pull group) before
+        moving to the next bucket — weights of early buckets stream back
+        while later buckets are still updating, and the next forward's
+        first-touch hooks absorb the wait.  Leftover (non-bucketable)
+        params were pushed serially by ``_bucketed_allreduce``; their
+        weights pull serially here."""
+        from ..ndarray import NDArray
+        buckets, leftover = plan
+        kv = self._kvstore_obj
+        _overlap.publish_pull_round(self._pull_scheduler)
+        all_keys = [i for b in buckets for i in b.indices]
+        overlap = self._pull_overlap_ok(all_keys, pull_stale)
+        for b in buckets:
+            flat = reduced[id(b)]
+            shapes = [self._params[i].shape for i in b.indices]
+            pieces = _engine.split_flat(flat._read(), shapes)
+            kv.apply_reduced(
+                list(b.indices),
+                [NDArray(piece, ctx=self._contexts[0])
+                 for piece in pieces])
+            if overlap:
+                # THIS bucket's weights go back on the wire before the
+                # next bucket updates — the full-duplex stream
+                self._pull_scheduler.issue(
+                    kv, list(b.indices),
+                    [self._params[i].list_data() for i in b.indices],
+                    label="pull[%s:%dp:%dB]" % (np.dtype(b.dtype).name,
+                                                len(b.indices), b.nbytes))
+        if not overlap and all_keys:
+            _overlap.serial_pull(
+                kv, all_keys,
+                [self._params[i].list_data() for i in all_keys])
+        if leftover:
+            kv.pull_many(leftover, [self._params[i].list_data()
+                                    for i in leftover])
 
     def _bucketed_update(self, plan, reduced):
         """One fused multi-tensor optimizer dispatch per (bucket,
@@ -650,9 +696,19 @@ class Trainer(object):
                            for i in b.indices]
                 grads = None if flat is not None else \
                     [self._params[i].list_grad()[j] for i in b.indices]
+                fg = flat
+                if flat is not None and j > 0:
+                    # replicas commit to distinct devices: the reduced
+                    # flat (context 0) must land on context j before the
+                    # fused jit sees mixed placements — this transfer IS
+                    # the per-context broadcast, bits preserved
+                    from ..ndarray import NDArray
+                    fg = NDArray(_engine.colocate(flat._read(),
+                                                  weights[0]._read()),
+                                 ctx=self._contexts[j])
                 opt.fused_bucket_update(optimizer, self._updaters[j],
                                         b.indices, weights, grads,
-                                        lrs[j], wds[j], flat_grad=flat)
+                                        lrs[j], wds[j], flat_grad=fg)
         for i in leftover:
             param = self._params[i]
             for upd, arr, grad in zip(self._updaters, param.list_data(),

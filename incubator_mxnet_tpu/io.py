@@ -23,7 +23,53 @@ from . import ndarray as nd
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "LibSVMIter", "MNISTIter", "ImageRecordIter", "PrefetchingIter",
-           "ResizeIter"]
+           "ResizeIter", "issue_device_prefetch", "device_prefetch_enabled"]
+
+
+def device_prefetch_enabled(override=None):
+    """GRAFT_PREFETCH_DEVICE (default on): issue batch N+1's
+    host→device transfer while batch N computes (graftduplex data
+    satellite) — the same issue/wait split ``ReduceHandle`` gave the
+    gradient wire, applied to H2D."""
+    if override is not None:
+        return bool(override)
+    return os.environ.get("GRAFT_PREFETCH_DEVICE", "1").strip().lower() \
+        not in ("0", "false", "no", "off")
+
+
+def issue_device_prefetch(obj):
+    """Issue ``jax.device_put`` for every NDArray reachable under
+    ``obj`` (an NDArray, a list/tuple, or a DataBatch) toward its own
+    context's device, under ``engine.offband()`` so an open bulk segment
+    on the calling thread is neither joined nor flushed.  The transfer
+    is an async dispatch: by the time the consumer first reads the
+    batch, the bytes are already on (or moving to) the device — H2D
+    rides under compute instead of serializing the first op of the next
+    forward.  Arrays already committed to the right device are left
+    untouched; placement errors degrade to a no-op (the consumer's
+    ordinary read still works)."""
+    from . import engine as _engine
+    if isinstance(obj, DataBatch):
+        issue_device_prefetch(obj.data)
+        issue_device_prefetch(obj.label)
+        return obj
+    if isinstance(obj, (list, tuple)):
+        for item in obj:
+            issue_device_prefetch(item)
+        return obj
+    if not isinstance(obj, NDArray):
+        return obj
+    try:
+        import jax
+        with _engine.offband():
+            v = obj._read()
+            dev = obj._ctx.jax_device()
+            devs = getattr(v, "devices", None)
+            if devs is not None and devs() != {dev}:
+                obj._write(jax.device_put(v, dev))
+    except Exception:
+        pass        # unknown placement / abstract value: nothing to move
+    return obj
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -221,6 +267,10 @@ class PrefetchingIter(DataIter):
             while not flags[i]:
                 try:
                     batch = it.next()
+                    if device_prefetch_enabled():
+                        # H2D for the lookahead batch issues on THIS
+                        # thread, riding under the consumer's compute
+                        issue_device_prefetch(batch)
                 except StopIteration:
                     batch = None
                 except Exception as exc:   # surface errors at the consumer

@@ -20,6 +20,7 @@ TPU-native rebirth of src/kvstore/ + python/mxnet/kvstore.py:
 """
 from __future__ import annotations
 
+import os
 import pickle
 import sys
 import time
@@ -53,30 +54,34 @@ def _wire_bytes(nbytes, compressor):
         return nbytes
     return max(nbytes // 16, 1)
 
-__all__ = ["KVStore", "ReduceHandle", "create", "create_kvstore"]
+__all__ = ["KVStore", "ReduceHandle", "PullHandle", "create",
+           "create_kvstore"]
 
 
-class ReduceHandle(object):
-    """One asynchronously issued bucket reduce (graftlap).
-
-    Returned by :meth:`KVStore.reduce_many_async`: the collective is
-    already ON THE WIRE (XLA dispatches asynchronously), ``values`` hold
-    the in-flight results, and :meth:`wait` blocks until they are ready.
-    Between issue and wait the handle keeps an open flight-recorder
-    bracket (``collective`` site, ``path="reduce_many_async"`` with the
-    bucket label), so a reduce that never lands is named by the watchdog
+class _AsyncHandle(object):
+    """Shared issue/wait split of the full-duplex wire (graftlap's
+    reduces + graftduplex's pulls): the collective work is already
+    dispatched at construction, ``values`` hold the in-flight results,
+    and :meth:`wait` blocks until ready.  Between issue and wait the
+    handle keeps an open flight-recorder bracket carrying the bucket
+    label, so a collective that never lands is named by the watchdog
     and shows up in crash dumps as the stuck in-flight bucket.
 
-    ``issued_at`` is the issue-time ``perf_counter()`` stamp — the
-    Trainer derives the overlap ratio (fraction of in-flight wall time
-    hidden under backward) from it."""
+    ``issued_at`` is the issue-time ``perf_counter()`` stamp — consumers
+    derive the overlap ratio (fraction of in-flight wall time hidden
+    under backward / the next forward) from it; :meth:`wait` records the
+    split as ``blocked_s`` (host visibly waiting) vs ``inflight_s``
+    (issue→wait-return, the upper bound on what was hidden)."""
 
-    __slots__ = ("values", "label", "issued_at", "_bracket", "_done")
+    __slots__ = ("values", "label", "issued_at", "blocked_s", "inflight_s",
+                 "_bracket", "_done")
 
     def __init__(self, values, label=None, _bracket=None):
         self.values = list(values)
         self.label = label
         self.issued_at = time.perf_counter()
+        self.blocked_s = 0.0
+        self.inflight_s = 0.0
         self._bracket = _bracket
         self._done = False
 
@@ -94,29 +99,38 @@ class ReduceHandle(object):
         flight" to "being waited on": re-stamp its clock and drop the
         ``async_pending`` flag so the watchdog starts aging it.  Before
         this, a long gap between issue and wait (a big backward, user
-        code between backward and step) is healthy overlap, not a hang —
-        the watchdog must not trip on it."""
+        code between backward and step, the next forward's early layers)
+        is healthy overlap, not a hang — the watchdog must not trip on
+        it."""
         entry = getattr(self._bracket, "entry", None)
         if entry is not None and entry.pop("async_pending", None):
             entry["since"] = time.time()
 
+    def _materialize(self):
+        """Hook for handles whose writes are deferred to wait time (the
+        dist_async host parameter service: the pull RPC runs on a
+        background thread and lands here)."""
+
     def wait(self):
-        """Block until the reduced values are ready; returns them.
+        """Block until the in-flight values are ready; returns them.
         Idempotent — later calls are free.  graftlens books the blocked
         span as exposed communication and the issue→wait-return span as
-        in-flight communication — an upper bound on the reduce time
-        graftlap hid under backward (a handle whose wait queues behind
-        earlier handles books their wait time too, the same convention
-        as ``graft_trainer_overlap_ratio``)."""
+        in-flight communication — an upper bound on the wire time the
+        overlap hid (a handle whose wait queues behind earlier handles
+        books their wait time too, the same convention as
+        ``graft_trainer_overlap_ratio``)."""
         if not self._done:
             self._done = True
             self._begin_wait()
             t0 = time.perf_counter()
             try:
+                self._materialize()
                 import jax
                 jax.block_until_ready([v._read() for v in self.values])
             finally:
                 t1 = time.perf_counter()
+                self.blocked_s = t1 - t0
+                self.inflight_s = t1 - self.issued_at
                 if self.values:
                     # an empty handle never hit the wire: booking its
                     # issue->wait gap would fake hidden communication
@@ -125,11 +139,42 @@ class ReduceHandle(object):
         return self.values
 
     def abandon(self):
-        """Drop the handle without consuming the result (the Trainer's
-        stale-grad fallback).  The dispatched work completes on its own;
-        only the bracket closes and the values are never read."""
+        """Drop the handle without consuming the result (the stale
+        fallback).  Any dispatched work completes on its own; only the
+        bracket closes and the values are never read."""
         self._done = True
         self._close()
+
+
+class ReduceHandle(_AsyncHandle):
+    """One asynchronously issued bucket reduce (graftlap) — see
+    :class:`_AsyncHandle`; returned by :meth:`KVStore.reduce_many_async`
+    with the reduce already on the wire (XLA dispatches asynchronously)."""
+
+    __slots__ = ()
+
+
+class PullHandle(_AsyncHandle):
+    """One asynchronously issued weight pull/broadcast (graftduplex).
+
+    Returned by :meth:`KVStore.pull_many_async`: the in-process stores
+    rebind the out arrays at ISSUE time (each ``_write`` is an async XLA
+    dispatch, so the bytes stream while the host moves on) and
+    :meth:`wait` only blocks until they are ready; the dist_async host
+    parameter service instead runs the pull RPC on a background thread
+    and applies the fetched values at wait time, version-gated per out
+    array (see ``DistKVStore.pull_many_async``).  Consumers (the
+    ``overlap.PullScheduler``) wait at FIRST USE of any out array in the
+    next forward, so updated weights ride under data loading and the
+    early layers.  ``stale`` counts out arrays whose pulled value was
+    dropped because the array was overwritten between issue and wait
+    (the serial ordering — pull, then user write — is preserved)."""
+
+    __slots__ = ("stale",)
+
+    def __init__(self, values, label=None, _bracket=None):
+        super().__init__(values, label=label, _bracket=_bracket)
+        self.stale = 0
 
 
 def _key_str(key):
@@ -315,6 +360,77 @@ class KVStore(object):
         stores have no peers: no-op (dist overrides)."""
         return None
 
+    def apply_reduced(self, keys, values):
+        """Apply ALREADY cross-worker-reduced gradients to the store —
+        the update_on_kvstore leg of the full-duplex step (graftduplex).
+
+        The duplex Trainer/Module path reduces a whole bucket as one
+        concatenated buffer (``reduce_many`` / ``reduce_many_async``),
+        splits it, and hands the per-key pieces here: each key gets the
+        store-side updater tick (server semantics, exactly what ``push``
+        would have run) or a plain assignment when no updater is set —
+        but NO second reduction and no extra collective.  Key order is
+        the caller's bucket order; per-key updates are independent, so
+        the result is bit-identical to the per-key ``push`` path."""
+        keys, vals = self._normalize(list(keys), list(values))
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError("key %s has not been initialized" % k)
+            red = vlist[0]
+            if self._updater is not None:
+                self._updater(_int_key(k), red, self._store[k])
+            else:
+                from . import engine as _engine
+                tgt = self._store[k]
+                tgt._write(_engine.colocate(
+                    red._read().astype(tgt.dtype), tgt._read()))
+
+    def pull_many_async(self, keys, outs, priority=0, label=None):
+        """Issue a batched multi-key pull WITHOUT waiting and return a
+        :class:`PullHandle` (graftduplex — the pull-side mirror of
+        :meth:`reduce_many_async`).
+
+        For the in-process stores the broadcast writes happen NOW — each
+        out array rebinds to the store value through an async XLA
+        dispatch, so the bytes stream back while the host runs data
+        loading and the next forward's early layers — and the handle's
+        ``wait()`` (fired by the consumer's first-touch weight hooks, or
+        at the latest at the start of the next step) is the only
+        synchronization point.  Until then the pull is an open
+        flight-recorder bracket carrying ``label``, so the watchdog and
+        crash dumps can name a stuck in-flight pull bucket.  Byte
+        accounting matches :meth:`pull` exactly; only the wait moves.
+        The dist_async parameter service overrides this with a
+        background-thread RPC + version-gated wait-time writes."""
+        keys, outs_n = self._normalize(list(keys), outs)
+        flat_outs = [o for olist in outs_n for o in olist]
+        nbytes = sum(_nd_bytes(o) for o in flat_outs)
+        bracket = _blackbox.collective(
+            "pull_many_async", n_keys=len(keys), keys=keys[:4],
+            nbytes=nbytes, bucket=label)
+        bracket.__enter__()
+        entry = getattr(bracket, "entry", None)
+        if entry is not None:
+            # watchdog contract (same as reduce_many_async): an async
+            # bracket ages only once someone blocks on it
+            entry["async_pending"] = True
+        try:
+            from . import engine as _engine
+            for k, olist in zip(keys, outs_n):
+                if k not in self._store:
+                    raise MXNetError("key %s has not been initialized" % k)
+                val = self._store[k]._read()
+                src_dtype = np.dtype(val.dtype)
+                for o in olist:
+                    v = val if np.dtype(o.dtype) == src_dtype \
+                        else val.astype(o.dtype)
+                    o._write(_engine.colocate(v, o._read()))
+        except BaseException:
+            bracket.__exit__(*sys.exc_info())
+            raise
+        _tmetrics.kvstore_pull(nbytes)
+        return PullHandle(flat_outs, label=label, _bracket=bracket)
+
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast store value into out list (ref: KVStore::Pull)."""
         assert out is not None
@@ -323,6 +439,7 @@ class KVStore(object):
         # recorder and the byte counter (every write below either lands
         # or raises, so the up-front sum IS the pulled total)
         nbytes = sum(_nd_bytes(o) for olist in outs for o in olist)
+        from . import engine as _engine
         with _blackbox.collective("pull", n_keys=len(keys), keys=keys[:4],
                                   nbytes=nbytes):
             for k, olist in zip(keys, outs):
@@ -330,12 +447,15 @@ class KVStore(object):
                     raise MXNetError("key %s has not been initialized" % k)
                 # hoist the store read out of the replica loop, and skip
                 # the astype copy when dtypes already match — the common
-                # Trainer pull (grad -> grad, same dtype) is a pure rebind
+                # Trainer pull (grad -> grad, same dtype) is a pure rebind.
+                # colocate: a multi-context replica list commits each out
+                # to its own device; the broadcast must land there
                 val = self._store[k]._read()
                 src_dtype = np.dtype(val.dtype)
                 for o in olist:
-                    o._write(val if np.dtype(o.dtype) == src_dtype
-                             else val.astype(o.dtype))
+                    v = val if np.dtype(o.dtype) == src_dtype \
+                        else val.astype(o.dtype)
+                    o._write(_engine.colocate(v, o._read()))
         _tmetrics.kvstore_pull(nbytes)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
@@ -373,9 +493,12 @@ class KVStore(object):
         if any(isinstance(v, BaseSparseNDArray) for v in vlist):
             # sparse-aware tree sum (ref: comm.h CommCPU ReduceRowSparse)
             return add_n(*vlist)
+        from . import engine as _engine
         acc = vlist[0]._read()
         for v in vlist[1:]:
-            acc = acc + v._read()
+            # replicas committed to distinct devices (multi-ctx lists)
+            # must be moved before the tree-sum — transfers preserve bits
+            acc = acc + _engine.colocate(v._read(), acc)
         return NDArray(acc, ctx=vlist[0]._ctx)
 
     @staticmethod
@@ -449,8 +572,15 @@ def create(name="local"):
 
 def create_kvstore(kvstore, num_device, arg_params):
     """Resolve a kvstore spec into (store, update_on_kvstore)
-    (ref: python/mxnet/model.py _create_kvstore)."""
-    update_on_kvstore = True
+    (ref: python/mxnet/model.py _create_kvstore, including the
+    MXNET_UPDATE_ON_KVSTORE env override — 0 keeps the update local,
+    which is also the switch that routes Module onto the bucketed
+    fused/overlapped reduce path, graftduplex)."""
+    try:
+        update_on_kvstore = bool(int(
+            os.environ.get("MXNET_UPDATE_ON_KVSTORE", "1")))
+    except ValueError:
+        update_on_kvstore = True
     if kvstore is None:
         kv = None
     elif isinstance(kvstore, KVStore):

@@ -59,12 +59,21 @@ class DataParallelExecutorGroup(object):
         self.label_names = None
         self.output_layouts = None
         self.num_outputs = None
+        self.backward_passes = 0    # graftduplex: the Module bucket
+        #                             scheduler's pass id (the role
+        #                             autograd.backward_pass_id plays
+        #                             for gluon) — bumped per backward
+        self.bind_generation = 0    # bumped per (re)bind: a reshape
+        #                             swaps every executor's arrays, so
+        #                             plans/hooks keyed on the old ones
+        #                             must rebuild
 
         self.bind_exec(data_shapes, label_shapes, shared_group)
 
     def bind_exec(self, data_shapes, label_shapes, shared_group=None,
                   reshape=False):
         """Bind one executor per context (ref: executor_group.py bind_exec)."""
+        self.bind_generation += 1
         self.batch_size = data_shapes[0][1][0]
         self.slices = _split_input_slice(self.batch_size, self.workload)
         self.data_shapes = [DataDesc(*ds) if not isinstance(ds, DataDesc)
@@ -151,6 +160,7 @@ class DataParallelExecutorGroup(object):
     def backward(self, out_grads=None):
         """ref: executor_group.py backward."""
         assert self.for_training, "re-bind with for_training=True to run backward"
+        self.backward_passes += 1
         for i, exe in enumerate(self.execs):
             og = None
             if out_grads is not None:

@@ -7,13 +7,16 @@ integration (update_on_kvstore semantics as in model.py _update_params*).
 from __future__ import annotations
 
 import logging
+import os
 import warnings
 
 import numpy as np
 
 from .. import context as ctx_mod
+from .. import engine as _engine
 from .. import ndarray as nd
 from .. import optimizer as opt
+from .. import overlap as _overlap
 from ..base import MXNetError
 from ..initializer import Uniform, InitDesc
 from ..io import DataDesc
@@ -67,6 +70,12 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        # graftduplex: Module rides the same full-duplex schedulers
+        # gluon.Trainer does — bucket reduces issued mid-backward by the
+        # executor's grad-ready hooks, update_on_kvstore weight pulls
+        # waited at first use in the next forward
+        self._scheduler = _overlap.BucketScheduler(self)
+        self._pull_scheduler = _overlap.PullScheduler()
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -369,32 +378,64 @@ class Module(BaseModule):
         """Apply gradient updates (ref: module.py update →
         model._update_params / _update_params_on_kvstore).  Phase spans
         separate the kvstore handshake from the local updater (graftscope
-        training-loop hooks)."""
+        training-loop hooks).
+
+        graftduplex: the kvstore leg is bucketed and overlapped.  On the
+        local-update path the executor's grad arrays carry grad-ready
+        hooks (fired by ``Executor.backward`` as it writes each grad), so
+        complete buckets ship their one-buffer allreduce mid-backward
+        through ``overlap.BucketScheduler`` — ``update()`` only waits,
+        splits, and writes the reduced flats back into every context's
+        grad arrays (bit-identical to the per-key push/pull: same
+        context tree-sum, same elementwise worker reduction, and the
+        write-back keeps the per-param updater contract).  On the
+        update_on_kvstore path the push stays the batched per-key wire
+        (the store updater's bookkeeping is per key — bit-identical
+        fallback) and the weight pulls ride ``overlap.PullScheduler``:
+        async per ~bucket group, waited at first touch in the next
+        forward.  Serial fallbacks: compression, sparse grads,
+        store-side updaters on the local path, GRAFT_OVERLAP[_PULL]=0,
+        stale (user-overwritten) weights."""
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
         from ..telemetry import blackbox as _blackbox
         from ..telemetry import tracing as _ttracing
         self._params_dirty = True
+        plan = None if self._kvstore is None or self._update_on_kvstore \
+            else self._module_bucket_plan()
+        overlap = plan is not None and self._overlap_enabled()
         # graftwatch step journal: Module's optimizer step lands as one
         # flight-recorder event with its phase latencies (the fwd/bwd
         # phases of forward_backward record as standalone phase events)
         with _blackbox.step_journal("module",
-                                    on_kvstore=self._update_on_kvstore):
+                                    on_kvstore=self._update_on_kvstore,
+                                    fused=plan is not None,
+                                    overlapped=overlap):
             if self._update_on_kvstore:
                 with _ttracing.phase_span("kvstore"):
-                    for idx, name in enumerate(self._param_names):
-                        grads = self._exec_group.grad_arrays[idx]
-                        self._kvstore.push(idx, grads, priority=-idx)
-                        self._kvstore.pull(
-                            idx, self._exec_group.param_arrays[idx],
-                            priority=-idx)
+                    # settle last round's in-flight weight pulls first
+                    # (stale user-overwritten weights downgrade this
+                    # round to the serial pull)
+                    stale = self._pull_scheduler.finish()
+                    keys = list(range(len(self._param_names)))
+                    self._kvstore.push_many(
+                        keys, [self._exec_group.grad_arrays[i]
+                               for i in keys])
+                    self._pull_module_weights(keys, stale)
                 return
             if self._kvstore:
                 with _ttracing.phase_span("kvstore"):
-                    for idx, name in enumerate(self._param_names):
-                        grads = self._exec_group.grad_arrays[idx]
-                        self._kvstore.push(idx, grads, priority=-idx)
-                        self._kvstore.pull(idx, grads, priority=-idx)
+                    if plan is None:
+                        self._scheduler.disarm()
+                        keys = list(range(len(self._param_names)))
+                        grads = [self._exec_group.grad_arrays[i]
+                                 for i in keys]
+                        # one batched multi-key push/pull: a single fused
+                        # dist collective instead of one round per key
+                        self._kvstore.push_many(keys, grads)
+                        self._kvstore.pull_many(keys, grads)
+                    else:
+                        self._module_bucketed_reduce(plan)
             with _ttracing.phase_span("update"):
                 for idx, name in enumerate(self._param_names):
                     for dev_i, (w, g) in enumerate(zip(
@@ -404,6 +445,211 @@ class Module(BaseModule):
                             continue
                         self._updater(idx * len(self._context) + dev_i,
                                       g, w)
+        # arm the grad-ready hooks so the NEXT backward issues each
+        # bucket's reduce the moment the executor finishes its grads
+        if overlap:
+            self._scheduler.arm(plan)
+        elif self._scheduler._armed:
+            self._scheduler.disarm()
+
+    # -- graftduplex: bucketed + overlapped kvstore leg ---------------------
+    _bucket_bytes_override = None     # tests/benches force a target here
+    _overlap_override = None          # tests/benches force overlap on/off
+    _overlap_pull_override = None     # tests/benches force pull overlap
+
+    def _bucket_target_bytes(self):
+        if self._bucket_bytes_override is not None:
+            return int(self._bucket_bytes_override)
+        try:
+            return int(os.environ.get(
+                "GRAFT_BUCKET_BYTES",
+                str(_overlap.DEFAULT_BUCKET_BYTES)))
+        except ValueError:
+            return _overlap.DEFAULT_BUCKET_BYTES
+
+    def _overlap_enabled(self):
+        if self._overlap_override is not None:
+            return bool(self._overlap_override)
+        return os.environ.get("GRAFT_OVERLAP", "1").strip().lower() \
+            not in ("0", "false", "no", "off")
+
+    def _overlap_pull_enabled(self):
+        return _overlap.overlap_pull_enabled(self._overlap_pull_override)
+
+    # overlap.BucketScheduler host protocol: carriers ARE the executor
+    # grad arrays (Executor.backward fires their hooks as it writes);
+    # pass ids come from the exec group's backward counter, not autograd
+    _sched_autograd_hooks = False
+
+    def _sched_entries(self, b):
+        grad_arrays = self._exec_group.grad_arrays
+        out = []
+        for i in b.indices:
+            for j, g in enumerate(grad_arrays[i]):
+                if g is not None:
+                    out.append(((i, j), g, g))
+        return out
+
+    def _sched_eligible(self, b):
+        reqs = self._exec_group.execs[0].grad_req
+        return all(reqs.get(self._param_names[i]) == "write"
+                   for i in b.indices)
+
+    def _sched_kv(self):
+        return self._kvstore
+
+    def _sched_flat(self, b):
+        return self._module_bucket_flat(b)
+
+    def _sched_pass_id(self):
+        return self._exec_group.backward_passes
+
+    def _sched_label(self, b):
+        return "bucket[%s:%dp:%dB]" % (np.dtype(b.dtype).name,
+                                       len(b.indices), b.nbytes)
+
+    def _module_bucket_plan(self):
+        """Bucket plan for the non-update_on_kvstore kvstore leg, or
+        None for the serial per-key wire.  Buckets group by dtype (the
+        update itself stays the per-param updater, so no fused-kernel or
+        state-arity constraints); fallbacks: compression, a store-side
+        updater (its per-key bookkeeping must see every push), sparse
+        grads, unknown shapes.  Executor backward writes grads in
+        arg-list order, so buckets pack in index order — there is no
+        tape to feed (GRAFT_BUCKET_ORDER applies to gluon.Trainer)."""
+        kv = self._kvstore
+        target = self._bucket_target_bytes()
+        if kv is None or target <= 0 or kv._compressor is not None \
+                or kv._updater is not None:
+            return None
+        grad_arrays = self._exec_group.grad_arrays
+        descs = []
+        for i, name in enumerate(self._param_names):
+            glist = grad_arrays[i]
+            g0 = glist[0] if glist else None
+            descs.append(None if g0 is None else
+                         (str(g0.dtype), tuple(g0.shape),
+                          sum(1 for g in glist if g is not None)))
+        # bind_generation: a reshape swaps every executor's grad arrays,
+        # so a plan (and the hooks armed on it) must rebuild even when
+        # the shapes/dtypes happen to match
+        sig = (target, self._exec_group.bind_generation, tuple(descs))
+        cached = getattr(self, "_module_plan_cache", None)
+        if cached is not None and cached[0] == sig:
+            return cached[1]
+        open_buckets = {}       # dtype -> (indices, nbytes)
+        buckets, leftover = [], []
+        for i, d in enumerate(descs):
+            if d is None:
+                continue
+            dtype_s, shape, _n = d
+            from ..ndarray.sparse import BaseSparseNDArray
+            if any(isinstance(g, BaseSparseNDArray)
+                   for g in grad_arrays[i] if g is not None) or not shape:
+                leftover.append(i)
+                continue
+            dt = np.dtype(dtype_s)
+            nbytes = int(np.prod(shape)) * dt.itemsize
+            idxs, total = open_buckets.setdefault(dt, ([], 0))
+            idxs.append(i)
+            total += nbytes
+            if total >= target:
+                buckets.append(_overlap.Bucket(idxs, None, dt, total))
+                open_buckets.pop(dt)
+            else:
+                open_buckets[dt] = (idxs, total)
+        for dt, (idxs, total) in open_buckets.items():
+            buckets.append(_overlap.Bucket(idxs, None, dt, total))
+        plan = (buckets, leftover) if buckets else None
+        self._module_plan_cache = (sig, plan)
+        if plan is not None:
+            from ..telemetry import metrics as _tmetrics
+            _tmetrics.trainer_buckets([b.nbytes for b in buckets],
+                                      len(leftover))
+        return plan
+
+    def _module_bucket_flat(self, b):
+        """One bucket's concatenated local gradient — the SAME shared
+        packing math as gluon's (``overlap.concat_ctx_sum``): per-exec
+        flatten + committed-device-safe tree-sum in context order, so
+        the bucketed reduce is bit-identical to the per-key push's
+        ``KVStore._reduce``."""
+        grad_arrays = self._exec_group.grad_arrays
+        n_exec = len(self._exec_group.execs)
+        return _overlap.concat_ctx_sum(
+            [[grad_arrays[i][j] for i in b.indices]
+             for j in range(n_exec)])
+
+    def _module_bucketed_reduce(self, plan):
+        """Reduce every bucket as ONE concatenated buffer (buckets the
+        scheduler already issued mid-backward are only waited on), then
+        split and write the reduced values back into EVERY context's
+        grad arrays — the per-param updater downstream sees exactly what
+        the per-key push/pull would have left there."""
+        import time as _time
+        buckets, leftover = plan
+        kv = self._kvstore
+        if leftover:
+            grads = [self._exec_group.grad_arrays[i] for i in leftover]
+            kv.push_many(leftover, grads)
+            kv.pull_many(leftover, grads)
+        overlap = self._overlap_enabled()
+        issued = self._scheduler.take(plan) if overlap else {}
+        serial = [b for b in buckets if id(b) not in issued]
+        flats = {id(b): self._module_bucket_flat(b) for b in serial}
+        if serial:
+            kv.reduce_many([flats[id(b)] for b in serial])
+        reduced, exposed_s, inflight_s = {}, 0.0, 0.0
+        for b in buckets:
+            entry = issued.get(id(b))
+            if entry is None:
+                reduced[id(b)] = flats[id(b)]
+                continue
+            flat, handle = entry
+            t0 = _time.perf_counter()
+            handle.wait()
+            t1 = _time.perf_counter()
+            exposed_s += t1 - t0
+            inflight_s += t1 - handle.issued_at
+            reduced[id(b)] = flat
+        if overlap:
+            if issued:
+                kv.heartbeat()      # same wait-side heartbeat contract
+                #                     as gluon's overlapped step
+            from ..telemetry import metrics as _tmetrics
+            _tmetrics.trainer_overlap(len(issued), len(serial),
+                                      exposed_s, inflight_s)
+        grad_arrays = self._exec_group.grad_arrays
+        for b in buckets:
+            flat = reduced[id(b)]
+            shapes = [tuple(grad_arrays[i][0].shape) for i in b.indices]
+            pieces = _engine.split_flat(flat._read(), shapes)
+            for pos, i in enumerate(b.indices):
+                for g in grad_arrays[i]:
+                    if g is not None:
+                        g._write(_engine.colocate(pieces[pos], g._read()))
+
+    def _pull_module_weights(self, keys, stale=0):
+        """update_on_kvstore weight broadcast: async per ~bucket-size
+        group with first-touch waits (``overlap.PullScheduler``) when
+        the pull side is on; the synchronous batched ``pull_many``
+        otherwise.  ``stale`` > 0 — a weight the user overwrote while
+        its pull was in flight — forces one serial round
+        (abandon-and-fallback); sparse param arrays always pull
+        serially (exactly gluon's rails, via the shared
+        ``overlap.pull_round``)."""
+        from ..ndarray.sparse import BaseSparseNDArray
+        param_arrays = self._exec_group.param_arrays
+        overlap = self._overlap_pull_enabled() and not stale \
+            and not any(isinstance(w, BaseSparseNDArray)
+                        for i in keys for w in param_arrays[i])
+        sizes = [int(np.prod(param_arrays[i][0].shape))
+                 * np.dtype(param_arrays[i][0].dtype).itemsize
+                 for i in keys]
+        _overlap.pull_round(
+            self._pull_scheduler, self._kvstore, keys,
+            [param_arrays[i] for i in keys], sizes,
+            self._bucket_target_bytes(), overlap)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

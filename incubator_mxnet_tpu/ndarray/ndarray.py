@@ -58,6 +58,13 @@ class NDArray:
 
     __array_priority__ = 1000.0  # beat numpy in mixed expressions
 
+    # graftduplex first-touch hook: set per-instance by overlap.
+    # PullScheduler on arrays with an async weight pull in flight; the
+    # FIRST read waits the pull before the value escapes.  A class-level
+    # default keeps the hot-path check in _read to one attribute load
+    # that normally resolves here (None).
+    _touch_hook = None
+
     def __init__(self, data=None, ctx=None, base=None, offset=0, shape=None):
         self._ctx = ctx if ctx is not None else current_context()
         if base is not None:
@@ -124,12 +131,24 @@ class NDArray:
         flush this read forces: "read" for direct host reads of deferred
         values, "view" only when the _read_deferred fallback lands here
         after a view failed to defer."""
+        th = self._touch_hook
+        if th is not None:
+            # first use of a weight with an async pull in flight: the
+            # hook clears itself, then waits the pull group so the value
+            # returned below is the pulled one (graftduplex)
+            th(self)
         eng = _engine_mod()
         if self._base is None:
             if type(self._data) is eng._Pending:
                 self._data = eng.resolve(self._data, cause=cause)
             return self._data
         b = self._base
+        bth = b._touch_hook
+        if bth is not None:
+            # a view read IS a first use of its base: the slice below
+            # reads b._data, so a pending pull on the base must land
+            # first (the dist_async path defers its writes to wait time)
+            bth(b)
         if (type(self._data) is eng._Pending
                 and self._cache_version == b._version):
             # a deferred view extraction for the current base version:

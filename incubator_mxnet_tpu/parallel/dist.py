@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..kvstore import KVStore
+from ..kvstore import KVStore, PullHandle
 from ..telemetry import blackbox as _blackbox
 from ..telemetry import metrics as _tmetrics
 from . import compression
@@ -127,6 +127,26 @@ def _global_sum(flat):
 _ps_counter = [0]   # SPMD-identical creation index → rendezvous key
 
 
+class _PSPullHandle(PullHandle):
+    """Pull handle whose writes are deferred to wait time: the host
+    parameter-service RPC runs on a background thread (issuing it inline
+    would block — exactly the wait graftduplex exists to move), and the
+    fetched weights are applied at ``wait()``, version-gated per out
+    array so a weight the user overwrote between issue and wait keeps
+    the user's value (the serial pull-then-write ordering)."""
+
+    __slots__ = ("_fn",)
+
+    def __init__(self, values, fn, label=None, _bracket=None):
+        super().__init__(values, label=label, _bracket=_bracket)
+        self._fn = fn
+
+    def _materialize(self):
+        fn, self._fn = self._fn, None
+        if fn is not None:
+            self.stale = fn()
+
+
 class DistKVStore(KVStore):
     """dist_sync / dist_device_sync / dist_async over jax.distributed.
 
@@ -144,6 +164,7 @@ class DistKVStore(KVStore):
         self._hb_step = 0               # dist heartbeat step counter
         self._ps_server = None
         self._ps = None
+        self._pull_pool = None          # lazy 1-thread PS pull executor
         if type_ == "dist_async":
             from . import ps
             idx = _ps_counter[0]
@@ -224,6 +245,68 @@ class DistKVStore(KVStore):
             self._store[k]._write(_jnp.asarray(v).astype(
                 self._store[k].dtype))
         _tmetrics.kvstore_pull(pulled)
+
+    def _pull_executor(self):
+        """One background thread for async PS pulls: a single worker
+        serializes the GroupClient (it is not thread-safe) and keeps the
+        issue order deterministic."""
+        if self._pull_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pull_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="graft-ps-pull")
+        return self._pull_pool
+
+    def pull_many_async(self, keys, outs, priority=0, label=None):
+        """Async weight pull from the host parameter service: the RPC is
+        submitted to a background thread at issue time and the fetched
+        values are applied at ``wait()`` — version-gated per out array,
+        so an array the user overwrote between issue and wait keeps the
+        user's bytes (serial pull-then-write ordering) and counts toward
+        the handle's ``stale`` total (the consumer's abandon-and-fallback
+        signal).  The sync wire (no PS) takes the base issue-time-write
+        path."""
+        if self._ps is None:
+            return super().pull_many_async(keys, outs, priority=priority,
+                                           label=label)
+        from ..kvstore import _nd_bytes
+        keys_n, outs_n = self._normalize(list(keys), outs)
+        flat_outs = [o for olist in outs_n for o in olist]
+        nbytes = sum(_nd_bytes(o) for o in flat_outs)
+        bracket = _blackbox.collective(
+            "pull_many_async", n_keys=len(keys_n), keys=keys_n[:4],
+            nbytes=nbytes, bucket=label)
+        bracket.__enter__()
+        entry = getattr(bracket, "entry", None)
+        if entry is not None:
+            entry["async_pending"] = True
+        try:
+            fut = self._pull_executor().submit(
+                self._ps.pull, [str(k) for k in keys_n])
+        except BaseException:
+            import sys as _sys
+            bracket.__exit__(*_sys.exc_info())
+            raise
+        versions = [[o._version for o in olist] for olist in outs_n]
+        store = self._store
+
+        def _apply():
+            import jax.numpy as _jnp
+            fetched = fut.result()
+            stale = 0
+            for k, olist, vers in zip(keys_n, outs_n, versions):
+                v = fetched[str(k)]
+                # refresh the local mirror (the sync pull does too)
+                store[k]._write(_jnp.asarray(v).astype(store[k].dtype))
+                for o, ver in zip(olist, vers):
+                    if o._version != ver:
+                        stale += 1      # overwritten since issue: the
+                        continue        # user's write wins
+                    o._write(_jnp.asarray(v).astype(o.dtype))
+            return stale
+
+        _tmetrics.kvstore_pull(nbytes)
+        return _PSPullHandle(flat_outs, _apply, label=label,
+                             _bracket=bracket)
 
     def set_optimizer(self, optimizer):
         if self._ps is None:

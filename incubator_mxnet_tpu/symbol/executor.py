@@ -177,6 +177,17 @@ class Executor(object):
                 tgt._write(tgt._read() + g.astype(tgt._read().dtype))
             else:
                 tgt._write(g.astype(tgt._read().dtype))
+                # graftduplex: Module's grad arrays carry the same
+                # grad-ready hooks gluon's params do (overlap.
+                # BucketScheduler) — each write above is an async XLA
+                # rebind, so firing here lets complete buckets put their
+                # reduce on the wire while the vjp program is still
+                # executing on device.  "add" grads are never final per
+                # pass and never fire.  A broken hook must not take the
+                # user's backward down (autograd._fire_ready_hook
+                # isolates + logs; the scheduler falls back to serial).
+                if getattr(tgt, "_grad_ready_hook", None) is not None:
+                    autograd._fire_ready_hook(tgt)
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Re-bind with new shapes (ref: executor.h Reshape). Cheap here:

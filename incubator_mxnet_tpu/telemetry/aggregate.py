@@ -193,14 +193,15 @@ def _assign_ranks(artifacts):
 # clock alignment
 # ---------------------------------------------------------------------------
 
-# Async reduces (graftlap) are recorded at wait-return/abandon time —
-# a HOST-local instant, not the wire-synchronized exit a sync allreduce
-# has.  They are valid straggler-ENTER evidence (enter = issue time) but
-# must never serve as clock anchors or exit-spread evidence: a healthy
-# 40ms host lag before wait() would otherwise fabricate a 40ms clock
-# offset and blame an innocent rank.  Mirror of
-# blackbox._NO_STRAGGLER_PATHS.
-_ASYNC_PATHS = frozenset(["reduce_many_async"])
+# Async reduces (graftlap) and async weight pulls (graftduplex) are
+# recorded at wait-return/abandon time — a HOST-local instant, not the
+# wire-synchronized exit a sync allreduce has.  They are valid
+# straggler-ENTER evidence (enter = issue time) but must never serve as
+# clock anchors or exit-spread evidence: a healthy 40ms host lag before
+# wait() would otherwise fabricate a 40ms clock offset and blame an
+# innocent rank.  Sync pull collectives (path "pull") keep full exit
+# standing.  Mirror of blackbox._NO_STRAGGLER_PATHS.
+_ASYNC_PATHS = frozenset(["reduce_many_async", "pull_many_async"])
 
 
 def _anchors(artifact):

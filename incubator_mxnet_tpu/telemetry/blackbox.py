@@ -238,9 +238,10 @@ _ewma = {}                      # path -> EWMA seconds
 _EWMA_FLOOR = 1e-3              # ignore sub-ms noise for straggler calls
 # async brackets stay open from issue until the consumer waits, so their
 # "latency" measures how long the result was LEFT in flight (graftlap:
-# mostly the rest of the backward pass), not wire health — feeding that
+# mostly the rest of the backward pass; graftduplex pulls: until the
+# next forward first touches a weight), not wire health — feeding that
 # into the straggler EWMA would cry wolf on every well-overlapped step
-_NO_STRAGGLER_PATHS = frozenset(["reduce_many_async"])
+_NO_STRAGGLER_PATHS = frozenset(["reduce_many_async", "pull_many_async"])
 
 
 def _straggler_factor():

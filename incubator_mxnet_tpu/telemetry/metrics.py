@@ -576,6 +576,42 @@ def trainer_overlap(n_overlapped, n_serial, exposed_s, inflight_s):
             max(0.0, min(1.0, 1.0 - exposed_s / inflight_s)))
 
 
+def trainer_pull_overlap(n_overlapped, n_serial, exposed_s, inflight_s,
+                         stale=0):
+    """One round of weight pulls on the update_on_kvstore path
+    (graftduplex): how much of the pull/broadcast in-flight wall time was
+    hidden under the next forward (first-touch waits) and data loading.
+
+    ``exposed_s`` is host time actually blocked in ``PullHandle.wait``;
+    ``inflight_s`` the summed issue→wait-return wall time.  Mirrors
+    ``trainer_overlap`` on the reduce side; the serial pull path reports
+    with ``exposed == inflight`` so the two configurations stay
+    comparable."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    c = r.counter("graft_trainer_pull_buckets_total",
+                  "Weight-pull groups by issue mode (overlapped = async "
+                  "PullHandle waited at first touch; serial = pulled "
+                  "synchronously inside the step)", ("mode",))
+    c.inc(n_overlapped, mode="overlapped")
+    c.inc(n_serial, mode="serial")
+    r.histogram("graft_trainer_pull_exposed_seconds",
+                "Per-round pull wait time NOT hidden under the next "
+                "forward", (), buckets=_PHASE_BUCKETS).observe(exposed_s)
+    if stale:
+        r.counter("graft_trainer_pull_stale_total",
+                  "Out arrays whose async-pulled value was dropped "
+                  "because the array was overwritten between issue and "
+                  "wait (abandon-and-fallback)").inc(stale)
+    if inflight_s > 0:
+        r.gauge("graft_trainer_pull_overlap_ratio",
+                "Fraction of async weight-pull in-flight wall time hidden "
+                "under data loading / the next forward (last pull-bearing "
+                "round)").set(
+            max(0.0, min(1.0, 1.0 - exposed_s / inflight_s)))
+
+
 def trainer_fused_update(n_params):
     """One fused multi-tensor optimizer dispatch (per bucket, per
     context); latency lands on the existing ``update`` phase span."""

@@ -9,12 +9,15 @@
 # 2. telemetry smoke — dump a chrome trace from a 3-op bulked program and
 #    validate the schema + record→flush flow links (graftscope); a trace
 #    regression exits non-zero just like a lint finding.
-# 3. graftfuse + graftlap smoke — bench_eager.py --smoke steps a
-#    many-small-param Trainer through the bucketed fused path (asserting
-#    bit-parity with the per-param path) AND through the overlapped
-#    reduce path (grad-ready hooks issuing bucket allreduces
+# 3. graftfuse + graftlap + graftduplex smoke — bench_eager.py --smoke
+#    steps a many-small-param Trainer through the bucketed fused path
+#    (asserting bit-parity with the per-param path), through the
+#    overlapped reduce path (grad-ready hooks issuing bucket allreduces
 #    mid-backward, asserting bit-parity with the serial bucketed path),
-#    so a fused-step or overlap regression fails this tier.
+#    AND through the full-duplex update_on_kvstore step (reduces
+#    overlapped + per-bucket async weight pulls waited at first touch,
+#    duplex_step_* parity asserted), so a fused-step, overlap or duplex
+#    regression fails this tier.
 # 4. graftwatch smoke — telemetry --blackbox --selftest exercises the
 #    flight recorder end-to-end (engine flushes, kvstore collectives, a
 #    step journal, an in-flight bracket) and validates the dump schema.
