@@ -41,6 +41,12 @@ Round 8 (graftlens) adds ``lens_overhead_pct``: a real train loop
 (record scope, backward, kvstore collectives, step journal) timed with
 the per-step attribution engine on vs off — same < 2% bar as the flight
 recorder.
+
+Round 10 (grafttsan) adds ``tsan_overhead_pct``: the same real train
+loop (handles issued/waited, scheduler regions, NDArray writes — every
+instrumented site firing) with the happens-before race detector on vs
+off.  The detector is DEFAULT-OFF, so the number is informational; the
+enabled-mode design bar is < 10%.
 """
 import json
 import sys
@@ -351,6 +357,63 @@ def _lens_overhead_bench(iters=20, repeats=4, n_params=8, shape=(16, 16)):
     }
 
 
+def _tsan_overhead_bench(iters=20, repeats=4, n_params=8, shape=(16, 16)):
+    """grafttsan enabled-mode cost on a real overlapped train loop —
+    async reduce handles (issue/settle + value registry), scheduler
+    regions, and the NDArray._write hook all firing.  Interleaved
+    min-of-rounds with alternating mode order, like the lens bench.
+    Default-off means the bar is informational (<10% when enabled)."""
+    import jax.numpy as jnp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon
+    from incubator_mxnet_tpu.analysis import tsan
+
+    rs = np.random.RandomState(0)
+    ps = []
+    for k in range(n_params):
+        p = gluon.Parameter("tob%d" % k, shape=shape)
+        p.initialize(ctx=mx.cpu())
+        p.data()._write(jnp.asarray(rs.randn(*shape).astype(np.float32)))
+        ps.append(p)
+    trainer = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01},
+                            kvstore=mx.kv.create("dist_sync"))
+    trainer._bucket_bytes_override = 512
+    trainer._overlap_override = True
+
+    def loop():
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            with autograd.record():
+                loss = None
+                for p in ps:
+                    y = (p.data() * p.data()).sum()
+                    loss = y if loss is None else loss + y
+            loss.backward()
+            trainer.step(1)
+        ps[-1].data().asnumpy()
+        return time.perf_counter() - t0
+
+    for _ in range(3):
+        loop()                                   # warm compiles + plan
+    best = {True: float("inf"), False: float("inf")}
+    prev = tsan._ACTIVE[0]
+    try:
+        for r in range(repeats):
+            order = (False, True) if r % 2 == 0 else (True, False)
+            for state in order:
+                tsan.set_enabled(state)
+                best[state] = min(best[state], loop())
+    finally:
+        tsan.set_enabled(prev)
+        tsan.clear()
+    pct = (best[True] - best[False]) / best[False] * 100.0
+    return {
+        "tsan_on_step_ms": round(best[True] / iters * 1e3, 3),
+        "tsan_off_step_ms": round(best[False] / iters * 1e3, 3),
+        "tsan_overhead_pct": round(pct, 2),
+    }
+
+
 def _blackbox_overhead_bench(iters=ITERS, repeats=5):
     """Flight-recorder steady-state cost on the 64-op bulked dispatch
     chain: the same loop timed with the recorder ON (the default) vs
@@ -403,6 +466,7 @@ def smoke():
     res.update(_duplex_step_bench(iters=4, repeats=2))
     res.update(_blackbox_overhead_bench(iters=10, repeats=3))
     res.update(_lens_overhead_bench(iters=10, repeats=3))
+    res.update(_tsan_overhead_bench(iters=8, repeats=2))
     res["metric"] = "fused_step_smoke"
     res["backend"] = jax.default_backend()
     print(json.dumps(res))
@@ -560,12 +624,16 @@ def main():
     # -- graftlens: attribution overhead on a real train loop (round 8) --
     lens_overhead = _lens_overhead_bench()
 
+    # -- grafttsan: race-detector overhead, enabled mode (round 10) ------
+    tsan_overhead = _tsan_overhead_bench()
+
     print(json.dumps({
         **fused,
         **overlap,
         **duplex,
         **blackbox_overhead,
         **lens_overhead,
+        **tsan_overhead,
         "metric": "eager_small_op_dispatch",
         "backend": backend,
         "chain_len": CHAIN,

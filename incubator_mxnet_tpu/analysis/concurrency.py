@@ -1,0 +1,393 @@
+"""Pass 4 — static concurrency lint (GL2xx) over the package sources.
+
+The op-contract linter (pass 1) guards the registry; these rules guard
+the THREADED half of the codebase — the overlap schedulers, the
+flight-recorder/watchdog threads, the parameter-service threads and the
+data pipeline — where a latent bug is a rare hang in a multi-hour run
+rather than a red test.  All rules are AST scans over the package's own
+``.py`` sources (the same sources-as-truth approach as pass 1):
+
+=======  ==============================================================
+GL201    lock-order inversion: ``with <lockA>: ... with <lockB>``
+         somewhere and ``with <lockB>: ... with <lockA>`` elsewhere —
+         a cycle in the lexical lock-acquisition graph is a deadlock
+         waiting for the right interleaving
+GL202    module-global state written from a thread-entry function
+         (``threading.Thread(target=...)`` targets and ``run`` methods
+         of Thread subclasses) outside any ``with <lock>`` block
+GL203    incomplete ``_sched_*`` host protocol: a class implementing
+         part of the BucketScheduler host surface silently breaks the
+         scheduler at runtime (the protocol is duck-typed)
+GL204    a class that starts daemon threads / thread-pool executors but
+         defines no shutdown path (``close``/``shutdown``/``stop``/
+         ``__del__``/``__exit__``/``_stop_threads``) — its threads leak
+         past the owner's lifetime and show up as phantom in-flight
+         work in crash dumps
+=======  ==============================================================
+
+Suppression: a ``# graftlint: disable=GLxxx <reason>`` comment on the
+flagged line or the line directly above silences that finding (the
+``--`` separator of the pass-1 decorator syntax is also accepted).
+Findings anchor to real file:line sites, so suppressions live exactly
+where the deviation is.
+
+Lock identity is heuristic by design: any ``with`` context whose dotted
+name's last segment contains ``lock`` (case-insensitive) is treated as
+a lock; ``self._x_lock`` keys on the enclosing class, module globals on
+the module.  The acquisition graph is LEXICAL (nested ``with`` blocks
+within one function) — call-chain acquisition is out of scope and
+documented as such in docs/static_analysis.md.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .contracts import Diagnostic
+
+__all__ = ["RULES", "SCHED_PROTOCOL", "lint_source", "lint_file",
+           "lint_package", "package_root"]
+
+RULES = {
+    "GL201": "lock-order inversion in the lexical lock-acquisition graph",
+    "GL202": "module-global written from a thread target without a lock",
+    "GL203": "incomplete _sched_* scheduler host protocol",
+    "GL204": "daemon thread/executor owner without a shutdown path",
+}
+
+SCHED_PROTOCOL = ("_sched_entries", "_sched_eligible", "_sched_kv",
+                  "_sched_flat", "_sched_pass_id", "_sched_label")
+
+_SHUTDOWN_METHODS = {"close", "shutdown", "stop", "__del__", "__exit__",
+                     "_stop_threads"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Z0-9, ]+?)\s*(?:(?:--|\s)\s*(.*))?$")
+
+
+def _line_suppressions(source):
+    """{lineno: {code: reason}} for every suppression comment."""
+    out = {}
+    for i, line in enumerate(source.splitlines(), 1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            why = (m.group(2) or "").strip() or None
+            codes = {c: why for c in m.group(1).replace(" ", "").split(",")
+                     if c}
+            out[i] = codes
+    return out
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _lock_key(expr, module, cls):
+    """Identity of a lock-ish ``with`` context, or None.  ``self.x`` keys
+    on the enclosing class, bare/module names on the module — cross-file
+    graphs only join when both the scope and the name agree."""
+    dotted = _dotted(expr)
+    if not dotted or "lock" not in dotted[-1].lower():
+        return None
+    if dotted[0] == "self" and len(dotted) >= 2:
+        return ("%s.%s" % (cls, dotted[-1])) if cls else None
+    return "%s.%s" % (module, dotted[-1])
+
+
+class _FileFacts(object):
+    """Everything one file contributes: lock edges, thread facts,
+    per-rule findings local to the file."""
+
+    def __init__(self, filename, module):
+        self.filename = filename
+        self.module = module
+        self.lock_edges = []        # (held_key, inner_key, line)
+        self.lock_sites = {}        # key -> first (file, line)
+        self.findings = []          # (code, line, message)
+
+
+def _walk_locks(body, held, facts, module, cls):
+    """Lexical lock-nesting walk: record an edge held -> new for every
+    ``with`` whose context looks like a lock."""
+    for node in body:
+        new_held = held
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in node.items:
+                key = _lock_key(item.context_expr, module, cls)
+                if key is None and isinstance(item.context_expr, ast.Call):
+                    key = _lock_key(item.context_expr.func, module, cls)
+                if key is not None:
+                    facts.lock_sites.setdefault(
+                        key, (facts.filename, node.lineno))
+                    for h in new_held:
+                        if h != key:
+                            facts.lock_edges.append((h, key, node.lineno))
+                    acquired.append(key)
+            new_held = held + tuple(acquired)
+        if isinstance(node, ast.ClassDef):
+            _walk_locks(node.body, (), facts, module, node.name)
+            continue
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _walk_locks(node.body, (), facts, module, cls)
+            continue
+        _walk_locks(list(ast.iter_child_nodes(node)), new_held, facts,
+                    module, cls)
+
+
+def _is_thread_call(call):
+    d = _dotted(call.func)
+    return d is not None and d[-1] == "Thread"
+
+
+def _is_executor_call(call):
+    d = _dotted(call.func)
+    return d is not None and d[-1] in ("ThreadPoolExecutor",
+                                       "ProcessPoolExecutor")
+
+
+def _thread_entry_names(tree):
+    """Function/method names used as thread bodies: ``target=`` of any
+    Thread(...) call, plus ``run`` of Thread subclasses."""
+    entries = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_thread_call(node):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    d = _dotted(kw.value)
+                    if d:
+                        entries.add(d[-1])
+        if isinstance(node, ast.ClassDef):
+            bases = {(_dotted(b) or ("",))[-1] for b in node.bases}
+            if "Thread" in bases:
+                entries.add("run")
+    return entries
+
+
+def _check_thread_globals(fn, facts, module):
+    """GL202: stores to ``global``-declared names in a thread-entry
+    function, outside any lock-ish ``with`` block."""
+    declared = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            declared.update(node.names)
+    if not declared:
+        return
+
+    def walk(body, held):
+        for node in body:
+            new_held = held
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                # any lock-ish context counts as a guard here, including
+                # ``self._lock`` (identity does not matter for GL202)
+                if any(_lock_key(i.context_expr, module, "?")
+                       for i in node.items):
+                    new_held = held + 1
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id in declared \
+                        and new_held == 0:
+                    facts.findings.append((
+                        "GL202", node.lineno,
+                        "thread entry %r writes module-global %r outside "
+                        "any lock — concurrent with every other writer "
+                        "of that global" % (fn.name, t.id)))
+            walk(list(ast.iter_child_nodes(node)), new_held)
+
+    walk(fn.body, 0)
+
+
+def _class_method_names(cls_node):
+    names = set()
+    for node in cls_node.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _check_sched_protocol(cls_node, facts):
+    """GL203: partial ``_sched_*`` surface."""
+    names = _class_method_names(cls_node)
+    sched = {n for n in names if n.startswith("_sched_")}
+    if not sched:
+        return
+    missing = [m for m in SCHED_PROTOCOL if m not in names]
+    if missing:
+        facts.findings.append((
+            "GL203", cls_node.lineno,
+            "class %r implements %d _sched_* member(s) but is missing "
+            "%s — BucketScheduler hosts are duck-typed and fail only at "
+            "arm/issue time" % (cls_node.name, len(sched),
+                                ", ".join(missing))))
+
+
+def _spawns_daemon(call):
+    """Thread(...) with daemon=True (incl. super().__init__ of a Thread
+    subclass), or any thread-pool executor construction."""
+    if isinstance(call.func, ast.Attribute) and call.func.attr == "__init__":
+        # super().__init__(..., daemon=True) inside a Thread subclass
+        return any(kw.arg == "daemon"
+                   and isinstance(kw.value, ast.Constant)
+                   and kw.value.value is True for kw in call.keywords)
+    if _is_executor_call(call):
+        return True
+    if _is_thread_call(call):
+        return any(kw.arg == "daemon"
+                   and isinstance(kw.value, ast.Constant)
+                   and kw.value.value is True for kw in call.keywords)
+    return False
+
+
+def _check_daemon_shutdown(cls_node, facts):
+    """GL204: a class spawning daemon threads/executors with no shutdown
+    method."""
+    names = _class_method_names(cls_node)
+    if names & _SHUTDOWN_METHODS:
+        return
+    for node in ast.walk(cls_node):
+        if isinstance(node, ast.Call) and _spawns_daemon(node):
+            what = "thread pool" if _is_executor_call(node) \
+                else "daemon thread"
+            facts.findings.append((
+                "GL204", node.lineno,
+                "class %r starts a %s but defines no shutdown path "
+                "(one of %s) — the thread outlives its owner and shows "
+                "up as phantom in-flight work in crash dumps"
+                % (cls_node.name, what,
+                   "/".join(sorted(_SHUTDOWN_METHODS)))))
+            return                      # one finding per class suffices
+
+
+def _scan_file(source, filename, module):
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as exc:
+        facts = _FileFacts(filename, module)
+        facts.findings.append((
+            "GL201", exc.lineno or 1,
+            "file does not parse (%s) — concurrency lint skipped" % exc))
+        return facts
+    facts = _FileFacts(filename, module)
+    _walk_locks(tree.body, (), facts, module, None)
+    entries = _thread_entry_names(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in entries:
+            _check_thread_globals(node, facts, module)
+        if isinstance(node, ast.ClassDef):
+            _check_sched_protocol(node, facts)
+            _check_daemon_shutdown(node, facts)
+    return facts
+
+
+def _find_cycles(edges):
+    """Cycles in the acquisition graph; returns one representative edge
+    list per cycle (deduped by node set)."""
+    graph = {}
+    for a, b, _line in edges:
+        graph.setdefault(a, set()).add(b)
+    cycles, seen = [], set()
+
+    def dfs(start, node, path):
+        for nxt in graph.get(node, ()):
+            if nxt == start and len(path) >= 1:
+                key = frozenset(path + (nxt,))
+                if key not in seen:
+                    seen.add(key)
+                    cycles.append(path + (nxt,))
+            elif nxt not in path and len(path) < 6:
+                dfs(start, nxt, path + (nxt,))
+
+    for n in sorted(graph):
+        dfs(n, n, (n,))
+    return cycles
+
+
+def _diagnostics(facts_list, suppress_by_file):
+    diags = []
+
+    def emit(code, site, filename, line, message):
+        sup = suppress_by_file.get(filename, {})
+        codes = dict(sup.get(line, {}))
+        codes.update(sup.get(line - 1, {}))
+        diags.append(Diagnostic(code, site, message, filename, line,
+                                suppressed=code in codes,
+                                justification=codes.get(code)))
+
+    # GL201: cycles over the union graph (cross-file, keys must match)
+    all_edges, sites = [], {}
+    for facts in facts_list:
+        for a, b, line in facts.lock_edges:
+            all_edges.append((a, b, line))
+            sites.setdefault((a, b), (facts.filename, line))
+    for cycle in _find_cycles(all_edges):
+        first = sites.get((cycle[0], cycle[1]),
+                          (facts_list[0].filename if facts_list else "?", 1))
+        emit("GL201", cycle[0], first[0], first[1],
+             "lock-order inversion: acquisition cycle %s — the converse "
+             "nesting exists elsewhere; pick one global order or merge "
+             "the locks" % " -> ".join(cycle))
+    for facts in facts_list:
+        for code, line, message in facts.findings:
+            emit(code, facts.module, facts.filename, line, message)
+    return diags
+
+
+def lint_source(source, filename="<memory>", module=None):
+    """Lint one source string (fixture tests)."""
+    module = module or os.path.splitext(os.path.basename(filename))[0]
+    facts = _scan_file(source, filename, module)
+    return _diagnostics([facts],
+                        {filename: _line_suppressions(source)})
+
+
+def lint_file(path):
+    with open(path) as f:
+        source = f.read()
+    return lint_source(source, filename=path)
+
+
+def package_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_package(root=None):
+    """Lint every ``.py`` file under the package (cross-file GL201
+    graph; per-file GL202-204)."""
+    root = root or package_root()
+    facts_list, suppress = [], {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, os.path.dirname(root))
+            module = rel[:-3].replace(os.sep, ".")
+            try:
+                with open(path) as f:
+                    source = f.read()
+            except OSError:
+                continue
+            facts_list.append(_scan_file(source, path, module))
+            suppress[path] = _line_suppressions(source)
+    return _diagnostics(facts_list, suppress)

@@ -1,4 +1,4 @@
-"""graftlint — CLI for the op-contract linter.
+"""graftlint — CLI for the op-contract + concurrency linters.
 
 Usage::
 
@@ -6,11 +6,16 @@ Usage::
            [--ops NAME[,NAME...]] [--list-rules]
 
 Imports the full ops package (registration side effects populate the
-registry and the registration log), runs every contract rule, and exits
-non-zero on unsuppressed findings.  ``--json`` emits the machine-readable
-report to stdout, ``--report PATH`` writes it to a file alongside the
-human summary (one linter pass serves both), and ``--contracts`` dumps
-every registered op's machine-readable contract (Operator.contract()).
+registry and the registration log), runs every contract rule (GL1xx),
+then the static concurrency rules (GL2xx — lock-order inversions,
+unguarded thread-shared globals, ``_sched_*`` protocol completeness,
+daemon threads without shutdown paths; analysis/concurrency.py) over the
+package sources, and exits non-zero on unsuppressed findings.  ``--ops``
+restricts to the op-contract pass.  ``--json`` emits the
+machine-readable report to stdout, ``--report PATH`` writes it to a file
+alongside the human summary (one linter pass serves both), and
+``--contracts`` dumps every registered op's machine-readable contract
+(Operator.contract()).
 
 Linting is platform-independent, so the CLI pins jax to CPU before the
 ops import — the axon sitecustomize otherwise force-selects the TPU
@@ -52,7 +57,7 @@ def _report_json(diags):
 
 
 def main(argv=None):
-    from . import contracts
+    from . import concurrency, contracts
 
     ap = argparse.ArgumentParser(
         prog="graftlint", description="op-contract static analyzer")
@@ -72,8 +77,10 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        for code in sorted(contracts.RULES):
-            print("%s  %s" % (code, contracts.RULES[code]))
+        rules = dict(contracts.RULES)
+        rules.update(concurrency.RULES)
+        for code in sorted(rules):
+            print("%s  %s" % (code, rules[code]))
         return 0
 
     _force_cpu_platform()
@@ -94,6 +101,10 @@ def main(argv=None):
         return 0
 
     diags = contracts.lint_all(names=names)
+    if names is None:
+        # the concurrency tier lints the package sources, not ops — an
+        # --ops-restricted run (fixture tests) skips it
+        diags += concurrency.lint_package()
     active = [d for d in diags if not d.suppressed]
     report = _report_json(diags)
 

@@ -1,0 +1,310 @@
+"""Lockstep divergence auditor — mechanical enforcement of the SPMD
+collective contract.
+
+Every dist path in this codebase keeps one documented invariant (the
+"lockstep contract", ``telemetry/blackbox.py``): **the sequence of
+collectives each rank issues — order, shape class, payload — is
+identical on every rank**, because the tape, the bucket plans and the
+env switches are SPMD-identical.  A single rank deviating (a skipped
+bucket, a swapped issue order, a rank-local env flip) does not fail
+loudly: it silently mispairs XLA collectives and the job hangs or —
+worse — computes wrong sums.  Nothing enforced the contract until now.
+
+The auditor folds every collective bracket's identity —
+``(seq, path, n_keys, nbytes, keys-digest)`` — into a **rolling hash**
+(crc32-combined, kept in int32 range so it rides the existing heartbeat
+allreduce verbatim) and keeps a bounded **divergence table** of the
+recent per-seq entries.  ``parallel/dist.py`` piggybacks each rank's
+``(last_seq, rolling_hash)`` on the worker-heartbeat vector; every rank
+then calls :func:`observe` with the full per-rank table, and the FIRST
+seq observed with two distinct hashes is reported — rank(s) named,
+before a mispaired wire turns into a silent deadlock — via the
+flight-recorder ring (``lockstep_divergence``), the
+``graft_lockstep_divergence_total`` counter and a log line.  The local
+table also lands in every flight-recorder dump (``blackbox.snapshot``),
+so the watchdog's hang dump carries the evidence and
+``telemetry/aggregate.py::lockstep_check`` can pinpoint the exact
+divergent collective offline from N rank dumps.
+
+Host-service paths (``ps_push``/``ps_pull``/``ps_push_async``) are
+excluded from the fold: dist_async workers legitimately push at their
+own pace — the wire is TCP, not a paired collective.  For those,
+:func:`note_order` asserts per-path monotonic issue order instead (the
+graftduplex background push client must preserve submission order on
+the wire).
+
+Master switch ``GRAFT_LOCKSTEP_CHECK`` (default on — the fold is a
+crc32 + deque append per collective).  Like ``GRAFT_BLACKBOX``, set it
+IDENTICALLY on every rank: the heartbeat vector's shape depends on it.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import zlib
+from collections import deque
+
+__all__ = ["enabled", "set_enabled", "fold", "state", "observe",
+           "note_order", "divergence", "table", "snapshot", "reset",
+           "keys_digest", "EXCLUDED_PATHS", "TABLE_SIZE"]
+
+# host parameter-service RPCs are rank-asymmetric by design (async SGD)
+EXCLUDED_PATHS = frozenset(["ps_push", "ps_pull", "ps_push_async"])
+
+TABLE_SIZE = 512                # recent per-seq entries kept for dumps
+_SEEN_SEQS = 128                # cross-rank observations retained
+_PRIME = 1000003
+
+_enabled_override = None
+
+
+def set_enabled(flag):
+    """Force the auditor on/off (None = defer to GRAFT_LOCKSTEP_CHECK)."""
+    global _enabled_override
+    _enabled_override = flag
+
+
+def enabled():
+    if _enabled_override is not None:
+        return bool(_enabled_override)
+    return os.environ.get("GRAFT_LOCKSTEP_CHECK", "1").strip().lower() \
+        not in ("0", "false", "no", "off")
+
+
+_lock = threading.Lock()
+_rolling = [0]                  # cumulative int31 hash of the fold stream
+_folds = [0]                    # fold-local index: the position of each
+#                                 folded collective WITHIN the audited
+#                                 stream.  The wire seq can NOT serve
+#                                 here: excluded ps_* brackets consume
+#                                 the shared blackbox counter at
+#                                 rank-dependent timing (dist_async's
+#                                 background push client), so raw seqs
+#                                 differ across ranks even for identical
+#                                 audited streams — hashing them would
+#                                 fabricate divergence on healthy jobs
+_last_wire_seq = [0]
+_table = deque(maxlen=TABLE_SIZE)   # (fold, wire seq, path, n_keys,
+#                                     nbytes, digest, rolling-after) —
+#                                     the divergence table
+_seen = {}                      # seq -> {rank: hash} from heartbeats
+_divergence = [None]            # first divergence report (latched)
+_order = {}                     # path -> next expected issue index
+_order_violations = []
+
+
+def _crc(text):
+    return zlib.crc32(text.encode("utf-8", "replace")) & 0x7fffffff
+
+
+def keys_digest(keys):
+    """Deterministic digest of a key list (process-hash-seed-proof)."""
+    if not keys:
+        return 0
+    return _crc(",".join(str(k) for k in keys))
+
+
+def fold(seq, path, n_keys=None, nbytes=None, keys=None):
+    """Fold one collective's identity into the rolling hash (called from
+    the blackbox collective bracket at seq-assignment time).  The hash
+    mixes the FOLD index, not the wire seq — see ``_folds``.  Returns
+    the rolling hash after the fold (None when disabled/excluded)."""
+    if not enabled() or path in EXCLUDED_PATHS:
+        return None
+    digest = _crc("%s|%s|%s|%s" % (path, n_keys, nbytes,
+                                   keys_digest(keys)))
+    with _lock:
+        _folds[0] += 1
+        _rolling[0] = (_rolling[0] * _PRIME + digest + _folds[0]) \
+            & 0x7fffffff
+        _last_wire_seq[0] = int(seq)
+        _table.append((_folds[0], int(seq), path, n_keys, nbytes, digest,
+                       _rolling[0]))
+        return _rolling[0]
+
+
+def state():
+    """(fold_count, rolling_hash) — what the heartbeat ships.  Both are
+    fold-local, so two ranks with identical audited streams match even
+    when rank-asymmetric ps_* brackets skewed their wire seqs."""
+    with _lock:
+        return _folds[0], _rolling[0]
+
+
+def divergence():
+    """The first detected divergence record, or None."""
+    return _divergence[0]
+
+
+def table(last=None):
+    """The recent divergence-table entries as dicts (oldest first).
+    ``fold`` is the audited-stream position (the online matching key);
+    ``seq`` the wire seq (the offline ``--analyze`` matching key)."""
+    with _lock:
+        rows = list(_table)
+    if last is not None:
+        rows = rows[-last:]
+    return [{"fold": fi, "seq": s, "path": p, "n_keys": nk, "nbytes": nb,
+             "digest": d, "rolling": r}
+            for fi, s, p, nk, nb, d, r in rows]
+
+
+def observe(rank_table, my_rank=None):
+    """Cross-check one heartbeat's per-rank ``{rank: (fold_count,
+    hash)}``.
+
+    Two detectors, both keyed on the rank-comparable FOLD index:
+
+    * **exact-position match** — two ranks reporting different rolling
+      hashes for the SAME fold count diverged at or before it (the
+      hash is cumulative); positions accumulate across heartbeats in
+      ``_seen`` since ranks advance at different moments;
+    * **self-table lookback** — a peer's ``(fold, hash)`` is compared
+      against the LOCAL divergence table's rolling hash at that same
+      fold.  This is what catches a *skipped* collective: the skipping
+      rank's fold counts misalign with everyone else's forever after
+      (an exact-position match may never recur), but its hash at fold F
+      must equal our recorded rolling at fold F — a mere laggard
+      matches, a diverged stream does not.
+
+    The first divergence is reported once: a ``lockstep_divergence``
+    flight-recorder event carrying the per-rank hashes, the local
+    recent table, and the rank(s) disagreeing with the local stream.
+    Returns the report dict (or None)."""
+    if not enabled():
+        return None
+    report = None
+    with _lock:
+        for rank, (fold, h) in rank_table.items():
+            fold = int(fold)
+            if fold <= 0:
+                continue
+            _seen.setdefault(fold, {})[int(rank)] = int(h)
+        while len(_seen) > _SEEN_SEQS:
+            del _seen[min(_seen)]
+        if _divergence[0] is None:
+            report = _first_divergence_locked(my_rank)
+            if report is not None:
+                _divergence[0] = report
+    if report is not None:
+        _emit(report)
+    return report
+
+
+def _first_divergence_locked(my_rank):
+    """The earliest observed divergence, or None (call under _lock)."""
+    # self-table lookback: a peer's hash vs the local rolling at the
+    # same fold position
+    local_at = {fi: r for fi, _s, _p, _nk, _nb, _d, r in _table}
+    for fold in sorted(_seen):
+        for rank, h in sorted(_seen[fold].items()):
+            if my_rank is not None and int(rank) == int(my_rank):
+                continue
+            mine = local_at.get(fold)
+            if mine is not None and mine != h:
+                return {
+                    "first_divergent_fold": fold,
+                    "rank_hashes": {str(rank): h, str(my_rank): mine},
+                    "divergent_ranks": [int(rank)],
+                    "observer_rank": my_rank,
+                }
+    # exact-position cross-peer match (covers folds our table evicted)
+    for fold in sorted(_seen):
+        ranks = _seen[fold]
+        if len(set(ranks.values())) > 1:
+            my_hash = None
+            if my_rank is not None:
+                my_hash = ranks.get(int(my_rank))
+            if my_hash is None:
+                # fall back: majority hash plays "reference"
+                counts = {}
+                for v in ranks.values():
+                    counts[v] = counts.get(v, 0) + 1
+                my_hash = max(counts, key=counts.get)
+            return {
+                "first_divergent_fold": fold,
+                "rank_hashes": {str(r): v
+                                for r, v in sorted(ranks.items())},
+                "divergent_ranks": sorted(r for r, v in ranks.items()
+                                          if v != my_hash),
+                "observer_rank": my_rank,
+            }
+    return None
+
+
+def _emit(report):
+    try:
+        from ..telemetry import blackbox as _blackbox
+        _blackbox.record("lockstep_divergence",
+                         table=table(last=32), **report)
+    except Exception:
+        pass
+    try:
+        from ..telemetry import metrics as _metrics
+        _metrics.lockstep_divergence()
+    except Exception:
+        pass
+    import logging
+    logging.getLogger("graftlockstep").error(
+        "LOCKSTEP DIVERGENCE: rank(s) %s issued a different collective "
+        "stream — first divergent stream position (fold) <= %d (per-rank "
+        "rolling hashes %s). The wire will mispair; dump the flight "
+        "recorders and run `telemetry --analyze` on them to name the "
+        "exact collective.",
+        report["divergent_ranks"], report["first_divergent_fold"],
+        report["rank_hashes"])
+
+
+def note_order(path, issue_idx):
+    """Assert per-path monotonic issue order for host-service wires (the
+    graftduplex background push client): ``issue_idx`` values must
+    arrive 0, 1, 2, ...  A violation is recorded once per path."""
+    if not enabled():
+        return True
+    with _lock:
+        expected = _order.get(path, 0)
+        _order[path] = max(expected, issue_idx + 1)
+        ok = issue_idx == expected
+        if not ok:
+            if any(v["path"] == path for v in _order_violations):
+                return False
+            violation = {"path": path, "expected": expected,
+                         "got": issue_idx}
+            _order_violations.append(violation)
+    if not ok:
+        try:
+            from ..telemetry import blackbox as _blackbox
+            _blackbox.record("lockstep_order_violation", **violation)
+        except Exception:
+            pass
+        import logging
+        logging.getLogger("graftlockstep").error(
+            "issue-order violation on %r: executed index %d, expected %d "
+            "— the background client reordered the wire", path,
+            issue_idx, expected)
+    return ok
+
+
+def snapshot():
+    """Dump-embeddable auditor state (blackbox.snapshot folds this into
+    every flight-recorder dump, so a watchdog hang dump carries the
+    divergence table)."""
+    folds, rolling = state()
+    return {"enabled": enabled(), "folds": folds,
+            "last_wire_seq": _last_wire_seq[0],
+            "rolling_hash": rolling, "divergence": _divergence[0],
+            "order_violations": list(_order_violations),
+            "table": table(last=64)}
+
+
+def reset():
+    """Drop all auditor state (tests / between training jobs)."""
+    with _lock:
+        _rolling[0] = 0
+        _folds[0] = 0
+        _last_wire_seq[0] = 0
+        _table.clear()
+        _seen.clear()
+        _divergence[0] = None
+        _order.clear()
+        del _order_violations[:]

@@ -89,6 +89,7 @@ import jax.numpy as jnp
 
 from .analysis.engine_check import (EngineHazardError,
                                     check_segment_integrity, oracle_compare)
+from .analysis import tsan as _tsan
 from . import profiler as _profiler
 from .telemetry import blackbox as _blackbox
 from .telemetry import metrics as _tmetrics
@@ -155,6 +156,12 @@ class _BulkState(object):
     def __init__(self, size, check=False):
         self.size = size
         self.check = bool(check)  # strict-mode verifier (GRAFT_ENGINE_CHECK)
+        # the scope belongs to the thread that opened it: a deferred
+        # value resolved from any OTHER thread flushes this state while
+        # its owner may still be recording — grafttsan's EH203 hazard
+        self.owner_tid = threading.get_ident()
+        if _tsan.enabled():
+            _tsan.segment_open(self)    # remember the opening stack
         self.extract_meta = {}   # id(extract _Pending) -> (view weakref,
         #                          base weakref, base._version at record):
         #                          the read side of the strict-mode
@@ -541,6 +548,10 @@ def defer_view_write(view, value):
 def resolve(pending, cause="read"):
     """Materialize one deferred value (flushes its segment if needed)."""
     if pending.value is None:
+        if _tsan._ACTIVE[0]:
+            # a foreign-thread resolve flushes the owner's open segment
+            # mid-recording (EH203) — report before the flush proceeds
+            _tsan.check_segment(pending.state)
         flush(pending.state, cause=cause)
     if pending.error is not None:
         raise RuntimeError("bulk engine: the deferred segment holding this "

@@ -585,6 +585,29 @@ class ImageIter(io_mod.DataIter):
             self.imgrec.reset()
         self.cur = 0
 
+    def close(self):
+        """Shut the decode pool (and the native prefetch reader) down —
+        without it the pool's threads outlive the iterator (GL204) and
+        read as phantom in-flight work in crash dumps."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+        if self.imgrec is not None and hasattr(self.imgrec, "close"):
+            self.imgrec.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass                # interpreter teardown
+
+    def __exit__(self, et, ev, tb):
+        self.close()
+        return False
+
+    def __enter__(self):
+        return self
+
     def _decode_np(self, s):
         """Payload → HWC uint8 numpy image; raw passthrough when configured.
         Stays in numpy — NDArray wrapping happens only if augmenters run."""

@@ -28,6 +28,7 @@ import time
 import numpy as np
 import jax.numpy as jnp
 
+from .analysis import tsan as _tsan
 from .base import MXNetError
 from .ndarray import NDArray
 from .ndarray import ndarray as _nd
@@ -74,7 +75,7 @@ class _AsyncHandle(object):
     (issue→wait-return, the upper bound on what was hidden)."""
 
     __slots__ = ("values", "label", "issued_at", "blocked_s", "inflight_s",
-                 "_bracket", "_done")
+                 "_bracket", "_done", "__weakref__")
 
     def __init__(self, values, label=None, _bracket=None):
         self.values = list(values)
@@ -84,6 +85,11 @@ class _AsyncHandle(object):
         self.inflight_s = 0.0
         self._bracket = _bracket
         self._done = False
+        if _tsan._ACTIVE[0]:
+            # grafttsan: the values are now in flight — issue is a
+            # happens-before release; only wait() (the acquire) lets
+            # another thread touch them (EH201 otherwise)
+            _tsan.handle_issue(self)
 
     @property
     def done(self):
@@ -121,6 +127,14 @@ class _AsyncHandle(object):
         ``graft_trainer_overlap_ratio``)."""
         if not self._done:
             self._done = True
+            if _tsan._ACTIVE[0]:
+                # acquire the issue-time release: writes by the waiting
+                # thread from here on (incl. _materialize's deferred
+                # applies) are ordered after the issue.  The grafttsan
+                # registry stays live until the blocking section below
+                # returns — the wire owns the bytes until then, so a
+                # third-thread write mid-wait is still an EH201 race
+                _tsan.handle_acquire(self)
             self._begin_wait()
             t0 = time.perf_counter()
             try:
@@ -136,6 +150,7 @@ class _AsyncHandle(object):
                     # issue->wait gap would fake hidden communication
                     _lens.comm(t0, t1, inflight=t1 - self.issued_at)
                 self._close()
+                _tsan.handle_settle(self)
         return self.values
 
     def abandon(self):
@@ -143,6 +158,7 @@ class _AsyncHandle(object):
         fallback).  Any dispatched work completes on its own; only the
         bracket closes and the values are never read."""
         self._done = True
+        _tsan.handle_settle(self)   # no acquire edge: values unconsumed
         self._close()
 
 

@@ -29,6 +29,7 @@ from ..context import Context, current_context, cpu
 from ..ops.registry import get_op, Operator
 from .. import random_state
 from .. import config as _config
+from ..analysis import tsan as _tsan
 
 # MXTPU_ENGINE_TYPE=NaiveEngine → block after every dispatch (the
 # reference's synchronous debug engine, src/engine/naive_engine.cc);
@@ -137,6 +138,8 @@ class NDArray:
             # hook clears itself, then waits the pull group so the value
             # returned below is the pulled one (graftduplex)
             th(self)
+        if _tsan._ACTIVE[0]:
+            _tsan.on_read(self)     # EH204 for tracked shared arrays
         eng = _engine_mod()
         if self._base is None:
             if type(self._data) is eng._Pending:
@@ -199,6 +202,13 @@ class NDArray:
         rebind to it, and a view over a deferred base records the
         write-through as a ``_bulk_view_write`` node so the whole
         read-modify-write stays in one segment."""
+        if _tsan._ACTIVE[0]:
+            # grafttsan: a cross-thread write to an array an async
+            # reduce/pull handle still holds (EH201), or to a tracked
+            # shared array without a happens-before edge (EH204).  The
+            # raw flag (not enabled()) keeps the disabled cost of this
+            # hot path to one attribute load + index
+            _tsan.on_write(self)
         eng = _engine_mod()
         if type(value) is eng._Pending:
             value.owners.append(weakref.ref(self))

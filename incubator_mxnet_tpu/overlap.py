@@ -39,6 +39,7 @@ import weakref
 import numpy as np
 
 from . import engine as _engine
+from .analysis import tsan as _tsan
 
 __all__ = ["Bucket", "BucketScheduler", "PullScheduler", "bucket_order",
            "overlap_pull_enabled", "plan_pull_groups", "concat_ctx_sum",
@@ -259,6 +260,10 @@ class BucketScheduler(object):
         Steady state — same (cached) plan object, scheduler healthy —
         skips the reinstall: the next backward's first hook resets the
         pending sets via the pass-id rollover, so re-arming is O(1)."""
+        with _tsan.region(self, "arm"):
+            self._arm(plan)
+
+    def _arm(self, plan):
         if self._armed and not self._broken and self._plan is plan:
             self._abandon_all()
             for state in self._buckets.values():
@@ -297,6 +302,10 @@ class BucketScheduler(object):
 
     def disarm(self):
         """Drop hooks and abandon anything still in flight."""
+        with _tsan.region(self, "disarm"):
+            self._disarm()
+
+    def _disarm(self):
         for d in self._hooked:
             if getattr(d, "_grad_ready_hook", None) is self._hook:
                 d._grad_ready_hook = None
@@ -317,6 +326,18 @@ class BucketScheduler(object):
 
     # -- the hook (fires inside the host's backward) ------------------------
     def _on_ready(self, arr):
+        # grafttsan region: the hook mutates pending sets / handles; a
+        # consumer (arm/disarm/take) on another thread racing it is the
+        # EH202 hazard.  Per-gradient hot path — the raw flag keeps the
+        # disabled cost to one attribute load + index (the _write/_read
+        # convention); the once-per-step entry points go through region()
+        if _tsan._ACTIVE[0]:
+            with _tsan.region(self, "_on_ready"):
+                self._on_ready_locked(arr)
+        else:
+            self._on_ready_locked(arr)
+
+    def _on_ready_locked(self, arr):
         if not self._armed or self._broken:
             return
         host = self._host_ref()
@@ -374,6 +395,10 @@ class BucketScheduler(object):
         ``{id(bucket): (flat NDArray, ReduceHandle)}``.  Stale handles
         (grad versions moved since issue) are abandoned; everything is
         one-shot — the caller re-arms for the next step."""
+        with _tsan.region(self, "take"):
+            return self._take(plan)
+
+    def _take(self, plan):
         out = {}
         if self._host_ref() is None or not self._armed or self._broken:
             self._abandon_all()
@@ -445,6 +470,10 @@ class PullScheduler(object):
     def issue(self, kv, keys, outs, label=None):
         """Put one group's pull on the wire; ``outs`` is a list (per
         key) of out-NDArray lists (one per context replica)."""
+        with _tsan.region(self, "issue"):
+            return self._issue(kv, keys, outs, label=label)
+
+    def _issue(self, kv, keys, outs, label=None):
         flat = [o for olist in outs for o in olist]
         for o in flat:
             g = self._by_arr.get(id(o))
@@ -464,6 +493,17 @@ class PullScheduler(object):
 
     # -- the first-touch hook (fires inside NDArray._read) ------------------
     def _on_touch(self, arr):
+        # same single-owner contract as the reduce side's _on_ready: a
+        # first-touch hook racing issue/finish from another thread is
+        # EH202 under GRAFT_TSAN (raw-flag guard: this sits inside the
+        # _read hot path)
+        if _tsan._ACTIVE[0]:
+            with _tsan.region(self, "_on_touch"):
+                self._on_touch_locked(arr)
+        else:
+            self._on_touch_locked(arr)
+
+    def _on_touch_locked(self, arr):
         arr._touch_hook = None
         group = self._by_arr.get(id(arr))
         if group is None:
@@ -498,14 +538,19 @@ class PullScheduler(object):
         round, and by teardown).  Returns the stale-out count observed
         since the last :meth:`take_stats` — nonzero means the consumer
         should run the NEXT round serial (abandon-and-fallback)."""
-        for group in list(self._groups.values()):
-            self._finish_group(group)
-        return self.stale_total
+        with _tsan.region(self, "finish"):
+            for group in list(self._groups.values()):
+                self._finish_group(group)
+            return self.stale_total
 
     def abandon_all(self):
         """Drop every outstanding group without consuming (teardown
         fallback): hooks clear, brackets close, deferred writes (the PS
         path) are lost — only reached when waiting is no longer safe."""
+        with _tsan.region(self, "abandon_all"):
+            self._abandon_all()
+
+    def _abandon_all(self):
         for group in list(self._groups.values()):
             for o in group["outs"]:
                 if getattr(o, "_touch_hook", None) is self._hook:

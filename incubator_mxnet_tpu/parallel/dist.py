@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..analysis import lockstep as _lockstep
 from ..kvstore import KVStore, PullHandle
 from ..telemetry import blackbox as _blackbox
 from ..telemetry import metrics as _tmetrics
@@ -164,7 +165,12 @@ class DistKVStore(KVStore):
         self._hb_step = 0               # dist heartbeat step counter
         self._ps_server = None
         self._ps = None
-        self._pull_pool = None          # lazy 1-thread PS pull executor
+        self._pull_pool = None          # lazy 1-thread PS client executor
+        #                                 (async pulls AND duplex pushes:
+        #                                 one worker = FIFO = wire order)
+        self._push_futs = []            # in-flight async push futures
+        self._push_issue_idx = 0        # submission order, asserted on
+        #                                 the wire by lockstep.note_order
         if type_ == "dist_async":
             from . import ps
             idx = _ps_counter[0]
@@ -220,14 +226,142 @@ class DistKVStore(KVStore):
                 red = self._compressor.compress(k, red)
             batch[str(k)] = self._async_np(red)
         _tmetrics.kvstore_push(raw_bytes, wire_bytes)
-        with _blackbox.collective("ps_push", n_keys=len(batch),
-                                  nbytes=raw_bytes):
-            self._ps.push(batch)    # applied immediately server-side
+        if not self._duplex_push_enabled():
+            with _blackbox.collective("ps_push", n_keys=len(batch),
+                                      nbytes=raw_bytes):
+                self._ps.push(batch)    # applied immediately server-side
+            return
+        # graftduplex push side (ROADMAP, PR 9 follow-up): the reduce/
+        # compress above ran on the caller's thread (deterministic
+        # content), and the RPCs now ride the SAME 1-thread background
+        # client as the async pulls — per ~bucket-size group, so early
+        # groups stream to the server while the caller returns to its
+        # backward.  One executor worker = FIFO = submission order on
+        # the wire, which lockstep.note_order asserts per executed RPC;
+        # sync pulls/barriers drain the queue first (read-your-writes).
+        from .. import overlap as _overlap
+        items = list(batch.items())
+        sizes = [v.nbytes for _k, v in items]
+        pool = self._pull_executor()
+        for group in _overlap.plan_pull_groups(
+                list(range(len(items))), sizes, self._push_group_bytes()):
+            chunk = {items[i][0]: items[i][1] for i in group}
+            nb = sum(sizes[i] for i in group)
+            idx = self._push_issue_idx
+            self._push_issue_idx += 1
+            self._push_futs.append(
+                pool.submit(self._ps_push_task, chunk, idx, nb))
+        self._reap_pushes()
+
+    _duplex_push_override = None    # tests/benches force on/off
+
+    def _duplex_push_enabled(self):
+        """GRAFT_DUPLEX_PUSH (default on): batch dist_async gradient
+        pushes onto the background PS client instead of blocking the
+        step on the RPC.  Same-worker read-your-writes is preserved
+        (sync pulls and barriers drain the queue; async pulls ride the
+        same FIFO executor); cross-worker ordering was never promised —
+        async SGD staleness is the semantics."""
+        if self._ps is None:
+            return False
+        if self._duplex_push_override is not None:
+            return bool(self._duplex_push_override)
+        return os.environ.get("GRAFT_DUPLEX_PUSH", "1").strip().lower() \
+            not in ("0", "false", "no", "off")
+
+    def _push_group_bytes(self):
+        from .. import overlap as _overlap
+        try:
+            return int(os.environ.get(
+                "GRAFT_BUCKET_BYTES", str(_overlap.DEFAULT_BUCKET_BYTES)))
+        except ValueError:
+            return _overlap.DEFAULT_BUCKET_BYTES
+
+    def _ps_push_task(self, chunk, idx, nbytes):
+        """One push group's RPC, on the background client thread.  The
+        bracket opens HERE (enter/exit must share a thread), so an RPC
+        stuck on a dead server is a named in-flight collective for the
+        watchdog; note_order records an issue-order violation if the
+        executor ever reorders submissions."""
+        _lockstep.note_order("ps_push_async", idx)
+        with _blackbox.collective("ps_push_async", n_keys=len(chunk),
+                                  nbytes=nbytes):
+            self._ps.push(chunk)
+
+    def _reap_pushes(self):
+        """Drop completed push futures; surface the first failure at the
+        next push instead of never.  Done futures are pruned BEFORE the
+        raise, so one failed RPC cannot re-raise its stale exception on
+        every later call forever."""
+        pending, failed = [], None
+        for f in self._push_futs:
+            if not f.done():
+                pending.append(f)
+                continue
+            exc = f.exception()
+            if exc is not None and failed is None:
+                failed = exc
+        self._push_futs = pending
+        if failed is not None:
+            raise failed
+
+    def _drain_pushes(self):
+        """Wait every queued async push (the read-your-writes point:
+        sync pulls, barriers, shutdown).  EVERY future is waited even
+        when one fails — a caller catching the error must still hold
+        read-your-writes for its next sync pull."""
+        futs, self._push_futs = self._push_futs, []
+        failed = None
+        for f in futs:
+            try:
+                f.result()
+            except BaseException as exc:
+                if failed is None:
+                    failed = exc
+        if failed is not None:
+            raise failed
+
+    def barrier(self):
+        self._drain_pushes()    # a barrier promises peers see our pushes
+        super().barrier()
+
+    def close(self):
+        """Shut down the background PS client (draining queued pushes),
+        the client sockets, and — on the hosting rank — the parameter-
+        server threads.  Without this the 1-thread executor and the
+        server's accept/handler threads outlive the store (GL204) and
+        show up as phantom in-flight work in crash dumps."""
+        try:
+            self._drain_pushes()
+        except Exception:
+            pass                # teardown: the job is over either way
+        if self._pull_pool is not None:
+            self._pull_pool.shutdown(wait=True)
+            self._pull_pool = None
+        if self._ps is not None:
+            try:
+                self._ps.close()
+            except Exception:
+                pass
+            self._ps = None
+        if self._ps_server is not None:
+            try:
+                self._ps_server.shutdown()
+            except Exception:
+                pass
+            self._ps_server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass                # interpreter teardown
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if self._ps is None:
             return super().pull(key, out=out, priority=priority,
                                 ignore_sparse=ignore_sparse)
+        self._drain_pushes()    # a sync pull reads our own pushes
         import jax.numpy as _jnp
         from ..kvstore import _nd_bytes
         from ..telemetry import metrics as _tmetrics
@@ -311,12 +445,14 @@ class DistKVStore(KVStore):
     def set_optimizer(self, optimizer):
         if self._ps is None:
             return DistKVStore._sync_set_optimizer(self, optimizer)
+        self._drain_pushes()    # updater flip applies to LATER pushes
         self._ps.set_optimizer(optimizer)   # pickled to the server role
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         if self._ps is None:
             return super().row_sparse_pull(key, out=out, priority=priority,
                                            row_ids=row_ids)
+        self._drain_pushes()    # row reads must see our own pushes
         import jax.numpy as _jnp
         keys, _ = self._normalize(key, out)
         if row_ids is not None:
@@ -540,15 +676,34 @@ class DistKVStore(KVStore):
         milliseconds) and step count in its own slot of a (2W,) vector;
         the allreduce sum hands every rank the full table.  Feeds the
         per-step worker-skew histogram, the flight recorder's last-seen
-        table, and a straggler log line when the skew is extreme."""
+        table, and a straggler log line when the skew is extreme.
+
+        With GRAFT_LOCKSTEP_CHECK on (default; set it IDENTICALLY on
+        every rank — the vector SHAPE depends on it) the vector widens
+        to (4W,) and additionally carries each rank's collective-stream
+        rolling hash + FOLD COUNT (the audited-stream position, NOT the
+        wire seq — ps_* brackets skew wire seqs rank-dependently; see
+        analysis/lockstep.py): every rank then cross-checks the table
+        and a rank whose stream diverged is named — with the first
+        divergent stream position — BEFORE a mispaired collective turns
+        into a silent hang."""
         W = num_workers()
         self._hb_step += 1
         now_ms = int(time.time() * 1000) % (1 << 31)
-        vec = np.zeros((2 * W,), np.int32)
+        audit = _lockstep.enabled()
+        vec = np.zeros(((4 if audit else 2) * W,), np.int32)
         vec[rank()] = now_ms
         vec[W + rank()] = self._hb_step % (1 << 31)
+        if audit:
+            folds, rolling = _lockstep.state()
+            vec[2 * W + rank()] = rolling
+            vec[3 * W + rank()] = folds % (1 << 31)
         out = np.asarray(_global_sum(jnp.asarray(vec))).astype(np.int64)
-        ts_ms, steps = out[:W], out[W:]
+        ts_ms, steps = out[:W], out[W:2 * W]
+        if audit:
+            hashes, folds_by_rank = out[2 * W:3 * W], out[3 * W:]
+            _lockstep.observe({r: (int(folds_by_rank[r]), int(hashes[r]))
+                               for r in range(W)}, my_rank=rank())
         # mod-wrap unwrap: a rank that crossed the 2^31 ms boundary while
         # others have not would otherwise read as ~24 days of skew
         if ts_ms.max() - ts_ms.min() > (1 << 30):

@@ -413,6 +413,37 @@ def _render_analyze_text(report):
     else:
         lines.append("no cross-rank collectives matched (single artifact "
                      "or disjoint sequences)")
+    ls = report.get("lockstep") or {}
+    lines.append("")
+    if ls.get("first_divergent_seq") is not None:
+        lines.append("LOCKSTEP DIVERGENCE: rank(s) %s diverged — first "
+                     "bad seq %s (%d mismatch(es), %d hole(s) over %d "
+                     "matched seq(s))"
+                     % (ls.get("divergent_ranks"),
+                        ls["first_divergent_seq"],
+                        len(ls.get("mismatches") or ()),
+                        len(ls.get("holes") or ()),
+                        ls.get("seqs_checked", 0)))
+        for m in (ls.get("mismatches") or ())[:3]:
+            lines.append("  seq %-6s per-rank (path, n_keys, nbytes, "
+                         "label): %s" % (m["seq"],
+                                         json.dumps(m["per_rank"])))
+        for h in (ls.get("holes") or ())[:3]:
+            lines.append("  seq %-6s missing on rank %s"
+                         % (h["seq"], h["missing_rank"]))
+    elif ls.get("seqs_checked"):
+        lines.append("lockstep: %d matched collective seq(s), streams "
+                     "identical on ranks %s"
+                     % (ls["seqs_checked"], ls.get("ranks")))
+    elif ls.get("note"):
+        lines.append("lockstep: audit declined — %s" % ls["note"])
+    for r in ls.get("online_reports") or ():
+        lines.append("  online divergence report (rank %s): first bad "
+                     "stream position <= %s, hashes %s"
+                     % (r.get("rank"),
+                        r.get("first_divergent_fold",
+                              r.get("first_divergent_seq")),
+                        json.dumps(r.get("rank_hashes"))))
     for p in report["problems"]:
         lines.append("PROBLEM: %s" % p)
     return "\n".join(lines)

@@ -49,7 +49,8 @@ import tempfile
 from . import tracing as _tracing
 
 __all__ = ["load_artifact", "parse_artifact", "clock_offsets",
-           "merged_trace", "straggler_table", "analyze", "selftest"]
+           "merged_trace", "straggler_table", "lockstep_check",
+           "analyze", "selftest"]
 
 _BLACKBOX_SCHEMA = "graft-blackbox/1"
 
@@ -120,7 +121,8 @@ def _parse_dump(doc, source):
             "rank": int(rank) if rank is not None else None,
             "collectives": colls, "heartbeats": hbs, "spans": spans,
             "events": None, "anchor": None,
-            "clock_offset_s": doc.get("clock_offset_s")}
+            "clock_offset_s": doc.get("clock_offset_s"),
+            "lockstep": doc.get("lockstep")}
 
 
 def _parse_trace(doc, source):
@@ -456,6 +458,98 @@ def straggler_table(artifacts, offsets=None):
 
 
 # ---------------------------------------------------------------------------
+# lockstep divergence cross-check (grafttsan's auditor, offline half)
+# ---------------------------------------------------------------------------
+
+# host parameter-service RPCs are rank-asymmetric by design (async SGD):
+# mirror of analysis/lockstep.py EXCLUDED_PATHS
+_PS_PATHS = frozenset(["ps_push", "ps_pull", "ps_push_async"])
+
+
+def lockstep_check(artifacts):
+    """Audit the SPMD lockstep contract across rank artifacts: for every
+    collective seq observed on >= 2 ranks, the identity ``(path,
+    n_keys, nbytes, label)`` must agree — a mismatch names the exact
+    divergent collective the online rolling hash (analysis/lockstep.py)
+    could only bound.  Holes — a rank missing a seq inside its observed
+    range while peers have it — catch skipped collectives.  Any online
+    ``lockstep_divergence`` reports recorded in the dumps are surfaced
+    too."""
+    ranks = sorted({a["rank"] for a in artifacts})
+    # a ps_* bracket consumes the shared seq counter at rank-dependent
+    # timing (the dist_async background client), so on a ps-bearing
+    # artifact set seq N on one rank is simply a DIFFERENT collective
+    # than seq N on another — seq matching would blame healthy ranks.
+    # The lockstep contract is a sync-wire contract; decline the audit
+    # for async-wire sets (the online fold-index hash still covers them).
+    has_ps = any(c.get("path") in _PS_PATHS
+                 for a in artifacts for c in a["collectives"])
+    by_seq = {}
+    if not has_ps:
+        for key, rcs in _matched_collectives(artifacts).items():
+            if key[0] != "seq":
+                continue
+            sigs = {r: (c.get("path"), c.get("n_keys"), c.get("nbytes"),
+                        c.get("label"))
+                    for r, c in rcs}
+            if sigs:
+                by_seq[key[1]] = sigs
+    mismatches, holes = [], []
+    seq_range = {}              # rank -> (min seq, max seq) observed
+    for seq, sigs in by_seq.items():
+        for r in sigs:
+            lo, hi = seq_range.get(r, (seq, seq))
+            seq_range[r] = (min(lo, seq), max(hi, seq))
+    for seq in sorted(by_seq):
+        sigs = by_seq[seq]
+        if len(set(sigs.values())) > 1:
+            counts = {}
+            for v in sigs.values():
+                counts[v] = counts.get(v, 0) + 1
+            majority = max(counts, key=counts.get)
+            mismatches.append({
+                "seq": seq,
+                "per_rank": {str(r): list(v)
+                             for r, v in sorted(sigs.items())},
+                "divergent_ranks": sorted(r for r, v in sigs.items()
+                                          if v != majority),
+            })
+        for r, (lo, hi) in seq_range.items():
+            # only a hole INSIDE the rank's own observed range is
+            # evidence (ring eviction trims the edges legitimately)
+            if r not in sigs and lo < seq < hi:
+                holes.append({"seq": seq, "missing_rank": r})
+    online = []
+    for a in artifacts:
+        for s in a["spans"]:
+            if s["kind"] == "lockstep_divergence":
+                online.append(dict(s["data"], rank=a["rank"]))
+        ls = a.get("lockstep") or {}
+        if ls.get("divergence"):
+            online.append(dict(ls["divergence"], rank=a["rank"],
+                               source="dump-header"))
+    bad_seqs = [m["seq"] for m in mismatches] + [h["seq"] for h in holes]
+    divergent = sorted({r for m in mismatches
+                        for r in m["divergent_ranks"]}
+                       | {h["missing_rank"] for h in holes})
+    report = {
+        "seqs_checked": len(by_seq),
+        "ranks": ranks,
+        "first_divergent_seq": min(bad_seqs) if bad_seqs else None,
+        "divergent_ranks": divergent,
+        "mismatches": mismatches[:10],
+        "holes": holes[:10],
+        "online_reports": online[:10],
+    }
+    if has_ps:
+        report["note"] = ("async wire (ps_* collectives present): seq "
+                          "matching skipped — wire seqs are rank-skewed "
+                          "by the background client; the online "
+                          "fold-index hash remains authoritative")
+    return report
+
+
+# ---------------------------------------------------------------------------
 # the full analysis (CLI entry)
 # ---------------------------------------------------------------------------
 
@@ -483,6 +577,7 @@ def analyze(paths, merged_out=None):
         "cross_rank_flow_links": links,
         "straggler_summary": summary,
         "stragglers": rows,
+        "lockstep": lockstep_check(artifacts),
         "problems": problems,
     }
     if merged_out:

@@ -43,6 +43,7 @@ from collections import deque
 from contextlib import nullcontext as _nullcontext
 
 from . import lens as _lens
+from ..analysis import lockstep as _lockstep
 
 __all__ = ["enabled", "set_enabled", "record", "events", "stats",
            "in_flight", "inflight_entries", "progress", "last_progress",
@@ -271,11 +272,21 @@ class _Collective(object):
 
     def __enter__(self):
         self._t0 = time.perf_counter()
-        fields = dict(self.fields, seq=next(_collective_seq))
+        seq = next(_collective_seq)
+        fields = dict(self.fields, seq=seq)
         step = _lens.current_step()
         if step is not None:
             fields["step"] = step
         self.fields = fields
+        # lockstep divergence auditor: fold this collective's identity
+        # into the rank's rolling stream hash at the moment its seq is
+        # assigned (the SPMD issue order IS what the hash witnesses);
+        # host-service ps_* paths are excluded inside fold()
+        _lockstep.fold(seq, self.path, n_keys=fields.get("n_keys"),
+                       nbytes=fields.get("nbytes"),
+                       keys=fields.get("keys")
+                       or ([fields["bucket"]] if fields.get("bucket")
+                           else None))
         if self._bb:
             self.entry = _push_inflight(
                 "collective", dict(fields, path=self.path))
@@ -590,6 +601,13 @@ def snapshot(reason="manual", extra=None):
         "events": events(),
         "threads": _thread_stacks(),
     }
+    try:
+        # the lockstep divergence table rides every dump: a watchdog
+        # hang dump then carries the per-seq collective stream for
+        # telemetry --analyze to pinpoint the divergent rank offline
+        doc["lockstep"] = _lockstep.snapshot()
+    except Exception:
+        pass                    # a dying process must still dump
     if extra:
         doc.update(extra)
     return doc

@@ -715,6 +715,24 @@ def collective_slow(path):
                       ("path",)).inc(path=path)
 
 
+def tsan_report(code):
+    """One grafttsan race report (EH2xx, analysis/tsan.py)."""
+    if not enabled():
+        return
+    _REGISTRY.counter("graft_tsan_reports_total",
+                      "Happens-before race reports by diagnostic code",
+                      ("code",)).inc(code=code)
+
+
+def lockstep_divergence():
+    """One detected SPMD lockstep divergence (analysis/lockstep.py)."""
+    if not enabled():
+        return
+    _REGISTRY.counter("graft_lockstep_divergence_total",
+                      "Cross-rank collective-stream divergences detected"
+                      ).inc()
+
+
 _REGISTRY.register_collector(_collect_device_memory)
 _REGISTRY.register_collector(_collect_autograd_tape)
 _REGISTRY.register_collector(_collect_engine_stats)

@@ -27,6 +27,13 @@
 #    bucket plus a straggler table blaming rank 1; bench_eager --smoke
 #    (tier 3) additionally reports lens_overhead_pct against its < 2%
 #    budget (tracked in BENCH JSON, like blackbox_overhead_pct).
+# 6. grafttsan smoke — analysis.tsan --selftest forces one race per
+#    EH2xx rule through the real instrumented paths (handles, scheduler
+#    regions, bulk segments, tracked arrays), requires the exact
+#    diagnostic with both stacks, and requires a clean workload to stay
+#    silent.  graftlint --all (tier 1) now also runs the GL2xx static
+#    concurrency rules over the package sources; bench_eager --smoke
+#    reports tsan_overhead_pct (detector default-off; informational).
 #
 # Usage: tools/run_lint.sh [report.json]
 set -uo pipefail
@@ -34,6 +41,9 @@ cd "$(dirname "$0")/.."
 
 REPORT="${1:-/tmp/graftlint_report.json}"
 python -m incubator_mxnet_tpu.analysis.graftlint --all --report "$REPORT" \
+    || exit $?
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m incubator_mxnet_tpu.analysis.tsan --selftest \
     || exit $?
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_eager.py --smoke \
     || exit $?
