@@ -92,6 +92,7 @@ from .analysis.engine_check import (EngineHazardError,
 from .analysis import tsan as _tsan
 from . import profiler as _profiler
 from .telemetry import blackbox as _blackbox
+from .telemetry import lens as _lens
 from .telemetry import metrics as _tmetrics
 from .telemetry import tracing as _ttracing
 
@@ -774,7 +775,9 @@ def flush(state=None, cause="read"):
             with _blackbox.in_flight("engine_flush",
                                      {"segment": seg_id, "cause": cause,
                                       "nodes": len(instrs)}):
+                t_dispatch = time.perf_counter()
                 results = fn(ext)
+                t_dispatched = time.perf_counter()
                 if st.check and results:
                     # EH104 — the fusion-equivalence oracle: replay the
                     # segment UNFUSED (the same replay closure outside jit
@@ -805,7 +808,17 @@ def flush(state=None, cause="read"):
         # a dangling arrow would fail the trace validator
         device_time = _profiler.want_sync()
         if device_time and results:
+            # device-time lens: under sync mode dispatch→ready is the
+            # segment's device latency.  Booked as dispatch + residual
+            # wait, EXCLUDING any window between them (the EH104 oracle's
+            # host-side unfused replay under GRAFT_ENGINE_CHECK) — an
+            # undercount when the device was still busy during it, never
+            # an overcount of host work as device time.  Cache-miss
+            # spans still include XLA compile (marked cache:"miss").
+            _lens.device(t_dispatch, t_dispatched)
+            t_block = time.perf_counter()
             jax.block_until_ready(results)
+            _lens.device(t_block, time.perf_counter())
         begin = span_begin if prof_on else _profiler._now_us()
         _ttracing.segment_flush_span(
             seg_id, cause, begin, _profiler._now_us(),

@@ -411,7 +411,22 @@ class HybridBlock(Block):
         super().__init__(prefix=prefix, params=params)
         self._active = False
         self._cached_op = None
-        self._trace_shadows = None
+        self._shadow_tls = threading.local()
+
+    # trace shadows are installed for the DURATION OF A JIT TRACE
+    # (_trace_params) — and traces run on whatever thread triggered the
+    # compile (the serving batcher's dispatcher, a CachedOp first call).
+    # They must be THREAD-LOCAL: a plain attribute would leak another
+    # thread's in-flight tracers into a concurrent eager forward on this
+    # same block (UnexpectedTracerError at best, silently tracing the
+    # eager caller's math at worst).
+    @property
+    def _trace_shadows(self):
+        return getattr(self._shadow_tls, "shadows", None)
+
+    @_trace_shadows.setter
+    def _trace_shadows(self, value):
+        self._shadow_tls.shadows = value
 
     @property
     def _active_params(self):
@@ -551,6 +566,17 @@ class HybridBlock(Block):
     def hybrid_forward(self, F, x, *args, **kwargs):
         """Override to define the computation (ref: block.py hybrid_forward)."""
         raise NotImplementedError
+
+    def serving_fn(self, *example_args, train=False):
+        """graftserve forward entry point: ``(fn, param_vals)`` where
+        ``fn(param_vals, *input_vals)`` is the pure jittable inference
+        forward (the same functionalized trace ``CachedOp`` compiles)
+        and ``param_vals`` the name→raw-array weight snapshot the
+        serving :class:`~incubator_mxnet_tpu.serving.ModelRegistry`
+        treats as the residency unit.  One ``jax.jit`` of ``fn`` serves
+        every (shape-bucket) batch as ONE device call — XLA's compile
+        cache keys on the padded batch signature."""
+        return functionalize(self, *example_args, train=train)
 
 
 class SymbolBlock(HybridBlock):

@@ -251,6 +251,14 @@ class KVStore(object):
             if k in self._store:
                 raise ValueError("duplicate init of key %s" % k)
             self._store[k] = vlist[0].copy()
+            if _tsan._ACTIVE[0]:
+                # grafttsan tracked cell per store value (EH204): the
+                # store-side updater writes (push/apply_reduced) and
+                # pull reads run through NDArray._write/_read, so an
+                # unsynchronized cross-thread updater-write vs pull-read
+                # on the shared "server" copy is named with both stacks
+                _tsan.track(self._store[k],
+                            label="%s._store[%s]" % (self._type, k))
 
     def push(self, key, value, priority=0):
         """Aggregate value(s) into the store (ref: KVStore::Push).

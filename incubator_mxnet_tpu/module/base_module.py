@@ -137,6 +137,27 @@ class BaseModule(object):
             outputs = [out[0:out.shape[0] - pad] for out in self.get_outputs()]
             yield (outputs, nbatch, eval_batch)
 
+    def serving_fn(self):
+        """graftserve forward entry point: ``(fn, param_vals,
+        input_names)`` — the bound symbol + current parameters as a pure
+        jittable inference forward.  ``fn(param_vals, *input_vals)``
+        maps raw arrays to raw array outputs (inference semantics:
+        ``is_train=False``); ``input_names`` is this module's data-name
+        order.  The serving registry compiles ONE jit entry per
+        (model, shape-bucket) from it, so a dispatched batch is one XLA
+        program instead of the per-op executor replay ``forward``
+        runs."""
+        assert self.binded and self.params_initialized
+        from ..serving.loader import symbol_serving_fn
+        arg_params, aux_params = self.get_params()
+        param_vals = {}
+        for d in (arg_params, aux_params):
+            for n, v in d.items():
+                param_vals[n] = v._read()
+        input_names = list(self.data_names)
+        return (symbol_serving_fn(self._symbol, input_names), param_vals,
+                input_names)
+
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False,
                 sparse_row_id_fn=None):

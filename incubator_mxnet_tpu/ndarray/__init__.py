@@ -79,6 +79,13 @@ def load(fname):
     return _load(fname)
 
 
+def load_buffer(buf):
+    """In-memory .params parse (ref: MXNDArrayLoadFromBuffer) — the
+    loader the C predict surface and the serving registry share."""
+    from .utils import load_buffer as _load_buffer
+    return _load_buffer(buf)
+
+
 def onehot_encode(indices, out):
     """legacy helper (ref: python/mxnet/ndarray/ndarray.py onehot_encode)."""
     depth = out.shape[1]

@@ -17,6 +17,7 @@ TPU-native rebirth of include/mxnet/ndarray.h + src/ndarray/ndarray.cc:
 """
 from __future__ import annotations
 
+import time as _time
 import weakref
 
 import numpy as np
@@ -30,6 +31,7 @@ from ..ops.registry import get_op, Operator
 from .. import random_state
 from .. import config as _config
 from ..analysis import tsan as _tsan
+from ..telemetry import lens as _lens
 
 # MXTPU_ENGINE_TYPE=NaiveEngine → block after every dispatch (the
 # reference's synchronous debug engine, src/engine/naive_engine.cc);
@@ -760,6 +762,7 @@ def invoke(op: Operator, inputs, params, out=None):
                               args={"device_time": _profiler.want_sync()})
     if _span is not None:
         _span.__enter__()
+    _t_dispatch = None
     try:
         if recording:
             fn = op.bind(params, is_train)
@@ -768,9 +771,16 @@ def invoke(op: Operator, inputs, params, out=None):
                 wrapped = lambda *xs: fn(*xs, rng=rng)
             else:
                 wrapped = fn
+            # jax.vjp interleaves host linearization tracing with the
+            # execution — no clean dispatch instant exists, so the
+            # device ledger books only the residual wait below (an
+            # undercount, never host tracing booked as device time)
             out_vals, vjp_fn = jax.vjp(wrapped, *vals)
         else:
             fn = op.bind(params, is_train)
+            if _span is not None:
+                _t_dispatch = _time.perf_counter()  # after bind: the
+                #                                     executing call only
             out_vals = fn(*vals, **kw)
             vjp_fn = None
     except Exception as exc:
@@ -781,7 +791,15 @@ def invoke(op: Operator, inputs, params, out=None):
         raise
     if _span is not None:
         if _profiler.want_sync():
+            # device-time lens: under sync mode dispatch→ready IS this
+            # op's device latency — same ledger the sync-mode bulk
+            # flushes feed, so eager (unbulked) steps decompose too.
+            # Recorded ops book the blocking wait only (_t_dispatch is
+            # None there); cache-miss calls still include jit compile
+            _t_block = _time.perf_counter()
             jax.block_until_ready(out_vals)
+            _lens.device(_t_dispatch if _t_dispatch is not None
+                         else _t_block, _time.perf_counter())
         _span.__exit__()
     if _NAIVE_ENGINE:
         jax.block_until_ready(out_vals)

@@ -22,7 +22,7 @@ import struct
 
 import numpy as np
 
-__all__ = ["save", "load"]
+__all__ = ["save", "load", "load_buffer"]
 
 _LIST_MAGIC = 0x112
 _NDARRAY_V2_MAGIC = 0xF993FAC9
@@ -233,18 +233,24 @@ def _load_legacy_npz(fname):
     return out_dict if named else out_list
 
 
-def load(fname):
-    """Load a .params file (MXNet binary; legacy npz sniffed by header)
-    (ref: python/mxnet/ndarray/utils.py load → MXNDArrayLoad)."""
-    with open(fname, "rb") as f:
-        head = f.read(8)
-        if head[:2] == b"PK":              # zip → legacy npz container
-            return _load_legacy_npz(fname)
-        buf = head + f.read()
+def load_buffer(buf):
+    """Load a .params payload straight from ``bytes`` — the in-memory
+    twin of :func:`load` (ref: MXNDArrayLoadFromBuffer,
+    src/c_api/c_api.cc).  The C predict surface hands param bytes over
+    the ABI and the serving registry receives them from model stores;
+    neither should round-trip through a temp file just to parse a
+    buffer this module wrote in the first place."""
+    if bytes(buf[:2]) == b"PK":            # zip → legacy npz container
+        import tempfile
+        # np.load needs a seekable file; spool without touching disk
+        with tempfile.SpooledTemporaryFile(max_size=1 << 30) as f:
+            f.write(buf)
+            f.seek(0)
+            return _load_legacy_npz(f)
     r = _Reader(buf)
     magic, _reserved = r.read("<QQ")
     if magic != _LIST_MAGIC:
-        raise ValueError("not an MXNet NDArray file (bad magic 0x%x)"
+        raise ValueError("not an MXNet NDArray buffer (bad magic 0x%x)"
                          % magic)
     n = r.read("<Q")
     arrays = [_load_one(r) for _ in range(n)]
@@ -253,3 +259,14 @@ def load(fname):
     if names:
         return dict(zip(names, arrays))
     return arrays
+
+
+def load(fname):
+    """Load a .params file (MXNet binary; legacy npz sniffed by header)
+    (ref: python/mxnet/ndarray/utils.py load → MXNDArrayLoad)."""
+    with open(fname, "rb") as f:
+        head = f.read(8)
+        if head[:2] == b"PK":              # zip → legacy npz container
+            return _load_legacy_npz(fname)
+        buf = head + f.read()
+    return load_buffer(buf)

@@ -5,75 +5,65 @@ The native MXPred* functions embed an interpreter and drive this module:
 object with set_input/forward/output_shape/output_bytes — a minimal
 deployment surface mirroring the reference's c_predict_api.cc
 PredictorObj.
+
+Rebased onto graftserve (PR 11): the param bytes are parsed IN MEMORY
+by ``nd.load_buffer`` (no temp-file round trip) and the model registers
+into the process-wide serving :class:`~incubator_mxnet_tpu.serving.ModelRegistry`
+— the legacy C ABI and the serving runtime share ONE loader and one
+residency accounting, and ``forward`` is one compiled dispatch (a
+``CachedOp``-style jitted graph) instead of the per-op executor replay.
 """
 from __future__ import annotations
 
-import json
-import os
-import struct
-import tempfile
+import itertools
 
 import numpy as np
 
 __all__ = ["Predictor", "create_predictor"]
 
+_predictor_ids = itertools.count(1)
+
 
 class Predictor(object):
-    """One bound inference graph (ref: c_predict_api.cc PredictorObj)."""
+    """One bound inference graph (ref: c_predict_api.cc PredictorObj),
+    served through a graftserve registry handle."""
 
     def __init__(self, symbol_json, param_bytes, input_shapes):
-        from . import symbol as sym_mod
-        from . import ndarray as nd
-        from .context import cpu
-
-        self._sym = sym_mod.load_json(symbol_json)
-        # .params bytes → name → NDArray (arg:/aux: prefixes optional)
-        with tempfile.NamedTemporaryFile(delete=False) as f:
-            f.write(param_bytes)
-            path = f.name
-        try:
-            loaded = nd.load(path)
-        finally:
-            os.unlink(path)
-        arg_params, aux_params = {}, {}
-        if isinstance(loaded, dict):
-            for k, v in loaded.items():
-                if k.startswith("arg:"):
-                    arg_params[k[4:]] = v
-                elif k.startswith("aux:"):
-                    aux_params[k[4:]] = v
-                else:
-                    arg_params[k] = v
+        from .serving import default_registry
         self._input_shapes = {k: tuple(int(d) for d in v)
                               for k, v in input_shapes.items()}
-        args = {}
-        arg_shapes, _, aux_shapes = self._sym.infer_shape(
-            **self._input_shapes)
-        for name, shape in zip(self._sym.list_arguments(), arg_shapes):
-            if name in arg_params:
-                args[name] = arg_params[name]
-            else:
-                args[name] = nd.zeros(shape)
-        aux = {}
-        for name, shape in zip(self._sym.list_auxiliary_states(),
-                               aux_shapes):
-            aux[name] = (aux_params[name] if name in aux_params
-                         else nd.zeros(shape))
-        self._exe = self._sym.bind(cpu(), args, grad_req="null",
-                                   aux_states=aux)
+        self._name = "cpredict/%d" % next(_predictor_ids)
+        self._registry = default_registry()
+        # shared loader: nd.load_buffer parse + zeros for uncovered
+        # arguments (serving/loader.bytes_model — the C-predict contract)
+        self._handle = self._registry.load_bytes(
+            self._name, symbol_json, param_bytes, self._input_shapes)
+        # executor-bind dtype semantics: the C surface always hands f32
+        # buffers, and the old bind cast them to the model's dtype (an
+        # f16 .params payload computed in f16).  Mirror that: when the
+        # float params agree on one dtype, inputs cast to it.
+        _entry, params, _v = self._handle.acquire()
+        fdtypes = {np.dtype(v.dtype) for v in params.values()
+                   if np.dtype(v.dtype).kind == "f"}
+        self._input_dtype = fdtypes.pop() if len(fdtypes) == 1 \
+            else np.dtype(np.float32)
+        self._inputs = {}
         self._outputs = []
 
     def set_input(self, key, data_bytes):
-        arr = np.frombuffer(data_bytes, np.float32).reshape(
-            self._input_shapes[key])
-        from . import ndarray as nd
-        self._exe.arg_dict[key]._write(
-            nd.array(arr)._read().astype(
-                self._exe.arg_dict[key]._read().dtype))
+        self._inputs[key] = np.frombuffer(data_bytes, np.float32).reshape(
+            self._input_shapes[key]).astype(self._input_dtype)
         return True
 
     def forward(self):
-        self._outputs = self._exe.forward(is_train=False)
+        # an input never set_input()-ed runs as zeros — the executor-bind
+        # contract of the original C surface (bind filled nd.zeros)
+        vals = [self._inputs.get(name)
+                if self._inputs.get(name) is not None
+                else np.zeros(self._input_shapes[name], self._input_dtype)
+                for name in self._handle.input_names]
+        out = self._handle.predict(*vals)
+        self._outputs = list(out) if isinstance(out, tuple) else [out]
         return True
 
     def output_shape(self, index):
@@ -81,7 +71,13 @@ class Predictor(object):
 
     def output_bytes(self, index):
         return np.ascontiguousarray(
-            self._outputs[index].asnumpy().astype(np.float32)).tobytes()
+            np.asarray(self._outputs[index]).astype(np.float32)).tobytes()
+
+    def __del__(self):
+        try:
+            self._registry.unload(self._name)
+        except Exception:
+            pass        # interpreter teardown
 
 
 def create_predictor(symbol_json, param_bytes, input_shapes):

@@ -1,0 +1,140 @@
+"""graftserve — the production serving runtime (the ROADMAP
+"millions-of-users" scenario).
+
+Turns a hybridized :class:`~incubator_mxnet_tpu.gluon.HybridBlock`, a
+bound ``Module``, a raw ``Symbol`` or the legacy C-predict payload into
+a production predictor:
+
+* :class:`DynamicBatcher` — thread-safe request queue; batches assemble
+  under ``GRAFT_SERVE_MAX_BATCH`` / ``GRAFT_SERVE_MAX_WAIT_MS``, pad to
+  power-of-two shape buckets (one compiled signature per (model, shape,
+  bucket)) and dispatch as ONE device call, with a bit-parity probe
+  against the unbatched forward (serving/batcher.py);
+* :class:`ModelRegistry` — multi-model weight residency under
+  ``GRAFT_SERVE_MEMORY_BYTES`` with LRU eviction and versioned hot-swap
+  over ``KVStore.pull_many_async`` (serving/registry.py);
+* SLO telemetry — per-request queue_wait/batch_assembly/device_compute/
+  host_io decomposition with an exact-sum conservation contract,
+  ``graft_serve_*`` metrics incl. rolling p50/p99 gauges, blackbox
+  batch journals and watchdog-named stuck batches (serving/slo.py);
+* ``python -m incubator_mxnet_tpu.serving --selftest`` — the lint-tier
+  smoke; ``bench_serving.py`` — p50/p99 vs offered QPS plus
+  batched-vs-serial throughput in BENCH JSON.
+
+:class:`Server` bundles the three::
+
+    srv = serving.Server(max_wait_ms=2)
+    srv.load("mnist", block=net, example=example_x)
+    fut = srv.submit("mnist", x)            # ServeFuture
+    y = fut.get(timeout=1.0)
+    srv.swap("mnist", new_params)           # hot-swap, no torn weights
+    srv.close()
+"""
+from __future__ import annotations
+
+from .batcher import (DynamicBatcher, ServeFuture, ServeError,
+                      serve_max_batch, serve_max_wait_ms, parity_mode)
+from .registry import (ModelRegistry, ModelHandle, SwapTicket,
+                       serve_memory_bytes, serve_batch_mode,
+                       default_registry)
+from . import loader
+from . import slo
+
+__all__ = ["Server", "DynamicBatcher", "ServeFuture", "ServeError",
+           "ModelRegistry", "ModelHandle", "SwapTicket", "loader", "slo",
+           "serve_max_batch", "serve_max_wait_ms", "serve_memory_bytes",
+           "serve_batch_mode", "parity_mode", "default_registry"]
+
+
+class Server(object):
+    """Registry + batcher in one object — the serving runtime."""
+
+    def __init__(self, memory_bytes=None, max_batch=None, max_wait_ms=None,
+                 registry=None):
+        self.registry = registry if registry is not None \
+            else ModelRegistry(memory_bytes)
+        self.batcher = DynamicBatcher(self.registry, max_batch=max_batch,
+                                      max_wait_ms=max_wait_ms)
+
+    # -- model lifecycle -----------------------------------------------------
+    def load(self, name, block=None, example=None, module=None,
+             symbol=None, params=None, symbol_json=None, param_bytes=None,
+             input_shapes=None, input_names=None):
+        """Register a model from whichever source is given: ``block`` (+
+        ``example``), ``module``, ``symbol`` (+ ``params``), or
+        ``symbol_json`` + ``param_bytes`` (+ ``input_shapes``)."""
+        if block is not None:
+            return self.registry.load_block(name, block, example)
+        if module is not None:
+            return self.registry.load_module(name, module)
+        if symbol is not None:
+            return self.registry.load_symbol(
+                name, symbol, params, input_shapes=input_shapes,
+                input_names=input_names)
+        if symbol_json is not None:
+            return self.registry.load_bytes(name, symbol_json, param_bytes,
+                                            input_shapes)
+        raise ValueError("pass one of block=, module=, symbol=, "
+                         "symbol_json=")
+
+    def swap(self, name, new_params):
+        """Hot-swap ``name`` to a new weight version (streams in async,
+        flips atomically; in-flight requests keep the old version)."""
+        return self.registry.swap(name, new_params)
+
+    def begin_swap(self, name, new_params):
+        return self.registry.begin_swap(name, new_params)
+
+    # -- serving -------------------------------------------------------------
+    def submit(self, name, x):
+        """Enqueue one example; returns a :class:`ServeFuture`."""
+        return self.batcher.submit(name, x)
+
+    def predict(self, name, x, timeout=30.0):
+        """Synchronous convenience: submit + get."""
+        return self.submit(name, x).get(timeout)
+
+    def warmup(self, name, example, buckets=None):
+        """Pre-compile the (shape, bucket) signatures for ``example`` so
+        production dispatches never pay an XLA compile: one direct call
+        per bucket (and its parity probe) off the hot path."""
+        import numpy as np
+        import jax.numpy as jnp
+        from .batcher import normalize_example, request_signature
+        xs = normalize_example(example)     # the submit() normalization,
+        sig = request_signature(xs)         # so warmup compiles EXACTLY
+        #                                     the production signatures
+        entry, params, _version = self.registry.acquire(name)
+        if buckets is None:
+            buckets, b = [], 1
+            while b < self.batcher._max_batch:
+                buckets.append(b)
+                b <<= 1
+            buckets.append(self.batcher._max_batch)
+        for b in sorted(set(buckets)):
+            batched = [jnp.asarray(np.stack([v] * b)) for v in xs]
+            out = entry.jit_for(b)(params, *batched)
+            outs = out if isinstance(out, tuple) else (out,)
+            self.batcher._maybe_probe(name, sig, b, entry, params,
+                                      batched, outs)
+        return buckets
+
+    # -- lifecycle -----------------------------------------------------------
+    def stats(self):
+        return {
+            "registry": self.registry.stats(),
+            "queue_depth": self.batcher.queue_depth,
+            "batches": self.batcher.batches_total,
+            "requests": self.batcher.requests_total,
+            "slo": slo.summary(),
+        }
+
+    def close(self):
+        self.batcher.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

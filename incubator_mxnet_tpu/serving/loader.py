@@ -1,0 +1,153 @@
+"""graftserve model loaders — ONE place model bytes become a pure
+jittable forward.
+
+Every serving source funnels to the same ``(fn, param_vals,
+input_names)`` triple:
+
+* ``fn(param_vals, *input_vals)`` — a pure function of raw arrays,
+  jit-compiled ONCE per (model, shape-bucket) signature by the registry
+  (the paper's defining idea #3: Gluon hybridization → ``CachedOp``;
+  here XLA's compile cache IS the signature cache, the TVM-style
+  deployment-runtime split around a compiled graph),
+* ``param_vals`` — name → raw array, the weight-residency unit the
+  registry budgets/evicts/hot-swaps,
+* ``input_names`` — positional input order of ``fn``.
+
+Sources: a :class:`~incubator_mxnet_tpu.gluon.HybridBlock`
+(``functionalize``, the CachedOp trace), a bound ``Module`` or a raw
+``Symbol`` (``symbol_serving_fn`` over ``Symbol.eval_dict`` — the ops
+trace through the same jax level), and the legacy C-predict payload
+(symbol JSON + ``.params`` bytes) parsed IN MEMORY by
+``nd.load_buffer`` — the loader ``predict.Predictor`` now shares, so
+the C ABI surface and graftserve load weights identically.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["split_arg_aux", "load_params_bytes", "symbol_serving_fn",
+           "symbol_model", "block_model", "module_model", "bytes_model"]
+
+
+def split_arg_aux(loaded):
+    """Split an ``nd.load``/``nd.load_buffer`` dict into (arg_params,
+    aux_params), honoring the optional ``arg:``/``aux:`` name prefixes
+    (ref: python/mxnet/model.py load_checkpoint)."""
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+def load_params_bytes(param_bytes):
+    """``.params`` bytes → (arg_params, aux_params) name→NDArray dicts,
+    parsed in memory (``nd.load_buffer`` — no temp-file round trip)."""
+    from ..ndarray import load_buffer
+    loaded = load_buffer(param_bytes)
+    if not isinstance(loaded, dict):
+        raise ValueError("serving params must be a named .params payload "
+                         "(got an unnamed array list)")
+    return split_arg_aux(loaded)
+
+
+def symbol_serving_fn(sym, input_names):
+    """The pure inference forward of a Symbol: ``fn(param_vals,
+    *input_vals)`` evaluating the graph under a jit trace (ops dispatch
+    at the jax level, exactly like the CachedOp trace), with recording
+    and training off.  Outputs: one raw array, or a tuple for
+    multi-output symbols."""
+    input_names = list(input_names)
+
+    def fn(param_vals, *input_vals):
+        from .. import autograd
+        from ..ndarray import NDArray
+        merged = {n: NDArray(v) for n, v in param_vals.items()}
+        for n, v in zip(input_names, input_vals):
+            merged[n] = NDArray(v)
+        with autograd._scope(recording=False, training=False):
+            out = sym.eval_dict(merged)
+        outs = out if isinstance(out, list) else [out]
+        vals = tuple(o._read() for o in outs)
+        return vals[0] if len(vals) == 1 else vals
+
+    return fn
+
+
+def _raw(v):
+    """NDArray/np/jax array → raw jax-compatible array value."""
+    from ..ndarray import NDArray
+    if isinstance(v, NDArray):
+        return v._read()
+    import jax.numpy as jnp
+    return jnp.asarray(v)
+
+
+def symbol_model(sym, params, input_shapes=None, input_names=None):
+    """A Symbol + explicit params.  ``input_shapes`` (name→shape) or
+    ``input_names`` designate the data inputs; arguments covered by
+    neither get ZERO values of their inferred shapes (the C-predict
+    contract: missing params default to zeros).  Returns ``(fn,
+    param_vals, input_names)``."""
+    params = {k: _raw(v) for k, v in params.items()}
+    if input_names is None:
+        if input_shapes:
+            input_names = list(input_shapes.keys())
+        else:
+            input_names = [n for n in sym.list_arguments()
+                           if n not in params]
+    input_names = list(input_names)
+    missing = [n for n in sym.list_arguments() + sym.list_auxiliary_states()
+               if n not in params and n not in input_names]
+    if missing:
+        if not input_shapes:
+            raise ValueError(
+                "symbol arguments %r are neither params nor inputs; pass "
+                "input_shapes so their shapes can be inferred" % missing)
+        shapes = {k: tuple(int(d) for d in v)
+                  for k, v in input_shapes.items()}
+        arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
+        inferred = dict(zip(sym.list_arguments(), arg_shapes))
+        inferred.update(zip(sym.list_auxiliary_states(), aux_shapes))
+        import jax.numpy as jnp
+        for n in missing:
+            params[n] = jnp.zeros(inferred[n], np.float32)
+    return symbol_serving_fn(sym, input_names), params, input_names
+
+
+def bytes_model(symbol_json, param_bytes, input_shapes):
+    """The legacy C-predict payload: symbol JSON + ``.params`` bytes +
+    input shapes (ref: c_predict_api.cc MXPredCreate).  One in-memory
+    parse, zeros for uncovered arguments — the loader ``Predictor``
+    rides."""
+    from .. import symbol as sym_mod
+    sym = sym_mod.load_json(symbol_json)
+    arg_params, aux_params = load_params_bytes(param_bytes)
+    params = dict(arg_params)
+    params.update(aux_params)
+    return symbol_model(sym, params, input_shapes=input_shapes)
+
+
+def block_model(block, example, train=False):
+    """A (preferably hybridized) HybridBlock: the CachedOp-style
+    functionalized trace (``HybridBlock.serving_fn``).  ``example`` is
+    one example input (or tuple of inputs) used to resolve deferred
+    shapes.  Returns ``(fn, param_vals, input_names)`` — fn takes the
+    inputs positionally."""
+    from ..ndarray import NDArray
+    if not isinstance(example, (list, tuple)):
+        example = (example,)
+    example = [e if isinstance(e, NDArray) else NDArray(_raw(e))
+               for e in example]
+    fn, param_vals = block.serving_fn(*example, train=train)
+    input_names = ["input%d" % i for i in range(len(example))]
+    return fn, param_vals, input_names
+
+
+def module_model(module):
+    """A bound, initialized ``Module`` — ``BaseModule.serving_fn``."""
+    return module.serving_fn()

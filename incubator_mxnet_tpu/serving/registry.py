@@ -1,0 +1,497 @@
+"""graftserve model registry — multi-model weight residency with LRU
+eviction and versioned hot-swap.
+
+A :class:`ModelRegistry` owns N models.  Each model is ``(fn, params,
+version)``: one pure jittable forward (serving/loader.py) compiled once
+per shape-bucket signature by ``jax.jit``'s cache, plus the raw weight
+arrays — the RESIDENCY UNIT.
+
+* **Budget** — ``GRAFT_SERVE_MEMORY_BYTES`` (0/unset = unlimited; the
+  constructor's ``memory_bytes`` overrides).  Loading or reloading past
+  the budget evicts least-recently-USED models first (every dispatch
+  marks use).  An evicted model keeps its loader closure; the next
+  request reloads it transparently (``reload`` lifecycle tick).  The
+  ``graft_serve_resident_*`` gauges sit next to the engine's
+  ``graft_device_memory_bytes`` device gauges so residency and actual
+  allocator pressure read side by side.
+
+* **Hot-swap** — :meth:`begin_swap` streams a new weight version in via
+  ``KVStore.pull_many_async`` (the graftduplex PR 9 wire: out arrays
+  rebind through async XLA dispatches at issue, the open
+  flight-recorder bracket names the in-flight swap bucket for the
+  watchdog) while the OLD version keeps serving; :meth:`SwapTicket.commit`
+  waits the handle and flips the model's ``(params, version)`` pair
+  atomically under the registry lock.  A dispatch snapshots the pair
+  under the same lock, so no request ever sees torn weights —
+  every response is entirely old-version or entirely new-version.
+
+Thread-safety: ONE registry lock; grafttsan registers the registry as
+an EH202 region (entered inside the lock), so any future code path
+touching registry state without the lock is named under ``GRAFT_TSAN=1``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from ..analysis import tsan as _tsan
+from ..telemetry import metrics as _tmetrics
+from . import loader as _loader
+
+__all__ = ["ModelRegistry", "ModelHandle", "SwapTicket",
+           "serve_memory_bytes", "serve_batch_mode", "default_registry"]
+
+
+def serve_memory_bytes():
+    """GRAFT_SERVE_MEMORY_BYTES: registry residency budget in bytes
+    (0 or unset = unlimited)."""
+    try:
+        return int(os.environ.get("GRAFT_SERVE_MEMORY_BYTES", "0"))
+    except ValueError:
+        return 0
+
+
+def serve_batch_mode():
+    """GRAFT_SERVE_BATCH_MODE: how a padded bucket becomes one device
+    call.
+
+    * ``exact`` (default) — the bucket program is B per-example
+      subgraphs concatenated (each row IS the bucket-1 graph, so XLA's
+      per-shape lowering reproduces the unbatched forward bit-for-bit;
+      measured ~250x over per-row dispatch on the CPU bench — the win
+      is dispatch amortization, which is what dominates serving small
+      models);
+    * ``fused`` — the bucket program runs over the (B,)+shape batch
+      directly (true batched gemms, the maximum-kernel-efficiency mode
+      for real accelerators).  XLA may legally pick batch-size-dependent
+      kernels whose results differ by ULPs from the unbatched forward;
+      the batcher's parity probe demotes any (model, shape) where that
+      happens."""
+    v = os.environ.get("GRAFT_SERVE_BATCH_MODE", "exact").strip().lower()
+    return "fused" if v == "fused" else "exact"
+
+
+def _nbytes(param_vals):
+    total = 0
+    for v in param_vals.values():
+        n = 1
+        for s in v.shape:
+            n *= int(s)
+        total += n * np.dtype(v.dtype).itemsize
+    return total
+
+
+def _exact_batched(fn, bucket):
+    """The ``exact`` bucket program: ``bucket`` per-example subgraphs of
+    ``fn`` concatenated along the batch axis — ONE device call whose
+    row ``i`` is the bucket-1 graph of row ``i``, so the batched result
+    reproduces the unbatched forward bit-for-bit by construction."""
+    import jax.numpy as jnp
+
+    def batched(params, *xbs):
+        outs = [fn(params, *[xb[i:i + 1] for xb in xbs])
+                for i in range(bucket)]
+        if isinstance(outs[0], tuple):
+            return tuple(jnp.concatenate([o[k] for o in outs], 0)
+                         for k in range(len(outs[0])))
+        return jnp.concatenate(outs, 0)
+
+    return batched
+
+
+class ModelHandle(object):
+    """One registered model.  The handle stays valid across evictions
+    (weights reload on next use) and hot-swaps (version bumps); it is
+    what ``predict.Predictor`` keeps and what the batcher dispatches
+    through."""
+
+    __slots__ = ("name", "input_names", "_fn", "_jit", "_exact_jits",
+                 "_params", "_version", "_resident", "_loader", "_nbytes",
+                 "_registry", "loaded_at", "parity_ok", "no_batch",
+                 "__weakref__")
+
+    def __init__(self, registry, name, fn, param_vals, input_names,
+                 loader=None):
+        import jax
+        self._registry = registry
+        self.name = name
+        self.input_names = list(input_names)
+        self._fn = fn
+        self._jit = jax.jit(fn)
+        self._exact_jits = {}       # bucket -> jitted exact-batch program
+        self._params = dict(param_vals)
+        self._version = 1
+        self._resident = True
+        self._loader = loader
+        self._nbytes = _nbytes(self._params)
+        self.loaded_at = time.time()
+        # parity-probe verdicts live ON the handle: they are a property
+        # of this handle's PROGRAM, so they survive hot-swaps (same fn)
+        # but never leak to a different model re-registered under the
+        # same name (fresh handle, fresh verdicts)
+        self.parity_ok = set()      # (sig, bucket) probed clean (exact)
+        self.no_batch = set()       # sig demoted to per-request dispatch
+
+    def jit_for(self, bucket, mode=None):
+        """The compiled dispatch entry for one batch bucket: the plain
+        jit in ``fused`` mode (or bucket 1 — identical either way), the
+        concat-of-subgraphs program in ``exact`` mode (see
+        :func:`serve_batch_mode`)."""
+        mode = serve_batch_mode() if mode is None else mode
+        if bucket <= 1 or mode == "fused":
+            return self._jit
+        jit_fn = self._exact_jits.get(bucket)
+        if jit_fn is None:
+            import jax
+            jit_fn = self._exact_jits.setdefault(
+                bucket, jax.jit(_exact_batched(self._fn, bucket)))
+        return jit_fn
+
+    @property
+    def version(self):
+        return self._version
+
+    @property
+    def resident(self):
+        return self._resident
+
+    @property
+    def nbytes(self):
+        return self._nbytes
+
+    def acquire(self):
+        """Snapshot ``(handle, param_vals, version)`` for one dispatch —
+        atomic under the registry lock (hot-swap flips the same pair
+        there), marks LRU use, reloads if evicted."""
+        return self._registry.acquire(self.name)
+
+    def predict(self, *inputs):
+        """Direct single dispatch (no batching): one compiled device
+        call over ``inputs`` (raw arrays / NDArrays).  The legacy
+        C-predict surface serves through this."""
+        from ..ndarray import NDArray
+        import jax.numpy as jnp
+        vals = [v._read() if isinstance(v, NDArray) else jnp.asarray(v)
+                for v in inputs]
+        entry, params, _version = self.acquire()
+        return entry._jit(params, *vals)
+
+
+class SwapTicket(object):
+    """An in-flight hot-swap: new weights streaming in via one
+    ``pull_many_async`` handle while the old version serves.  ``commit``
+    waits the stream and flips atomically; ``abandon`` drops it (the old
+    version keeps serving)."""
+
+    __slots__ = ("_registry", "name", "target_version", "_outs", "_handle",
+                 "_done")
+
+    def __init__(self, registry, name, target_version, outs, handle):
+        self._registry = registry
+        self.name = name
+        self.target_version = target_version
+        self._outs = outs           # name -> out NDArray (streaming in)
+        self._handle = handle
+        self._done = False
+
+    @property
+    def done(self):
+        return self._done
+
+    def commit(self):
+        """Wait the in-flight pulls, then flip the model's (params,
+        version) pair atomically.  Returns the new version — assigned
+        at COMMIT time as a monotonic bump (``target_version`` is the
+        projection from begin_swap time; overlapping swaps each get a
+        distinct, increasing version, last commit wins the weights).
+        A failed wait leaves the ticket live: ``abandon()`` (or a
+        retry) still works — ``_done`` flips only on success."""
+        if self._done:
+            return self.target_version
+        self._handle.wait()             # may raise: ticket stays live
+        new_params = {n: o._read() for n, o in self._outs.items()}
+        self.target_version = self._registry._commit_swap(self.name,
+                                                          new_params)
+        self._done = True
+        return self.target_version
+
+    def abandon(self):
+        """Drop the swap without flipping (old version keeps serving)."""
+        if self._done:
+            return
+        self._done = True
+        self._handle.abandon()
+
+
+class ModelRegistry(object):
+    """name → :class:`ModelHandle` with LRU residency under a byte
+    budget."""
+
+    def __init__(self, memory_bytes=None):
+        self._lock = threading.RLock()
+        self._models = OrderedDict()        # name -> ModelHandle, LRU order
+        self._budget = serve_memory_bytes() if memory_bytes is None \
+            else int(memory_bytes)
+        self.loads_total = 0
+        self.reloads_total = 0
+        self.evictions_total = 0
+        self.swaps_total = 0
+
+    # -- loading -------------------------------------------------------------
+    @staticmethod
+    def _snapshot_loader(params):
+        """Reload closure over HOST copies of the load-time weights.
+        Reading the LIVE source block/module on reload would silently
+        fast-forward an evicted model to retrained weights under its
+        unchanged version number — the inverse of the stale-resurrection
+        hole ``_commit_swap`` closes.  An eviction must round-trip to
+        the exact registered version; new weights arrive ONLY via the
+        versioned swap path."""
+        host = {n: np.asarray(v) for n, v in params.items()}
+
+        def reload():
+            import jax.numpy as jnp
+            return {n: jnp.asarray(v) for n, v in host.items()}
+
+        return reload
+
+    def load_block(self, name, block, example, train=False):
+        """Register a (preferably hybridized) HybridBlock.  The weight
+        snapshot is taken NOW; training the block further does not
+        change what this registry serves — publish new weights with
+        :meth:`swap`."""
+        fn, params, input_names = _loader.block_model(block, example,
+                                                      train=train)
+        return self._install(name, fn, params, input_names,
+                             self._snapshot_loader(params))
+
+    def load_module(self, name, module):
+        """Register a bound, initialized Module (weights snapshotted at
+        load, like :meth:`load_block` — swap to publish new ones)."""
+        fn, params, input_names = _loader.module_model(module)
+        return self._install(name, fn, params, input_names,
+                             self._snapshot_loader(params))
+
+    def load_symbol(self, name, symbol, params, input_shapes=None,
+                    input_names=None):
+        """Register a Symbol + explicit params."""
+        fn, param_vals, input_names = _loader.symbol_model(
+            symbol, params, input_shapes=input_shapes,
+            input_names=input_names)
+        snapshot = dict(param_vals)
+        return self._install(name, fn, param_vals, input_names,
+                             lambda: dict(snapshot))
+
+    def load_bytes(self, name, symbol_json, param_bytes, input_shapes):
+        """Register the legacy C-predict payload (symbol JSON + .params
+        bytes, parsed in memory by ``nd.load_buffer``).  The BYTES are
+        retained host-side as the reload source, so eviction frees the
+        parsed device arrays while the model stays reloadable."""
+        fn, param_vals, input_names = _loader.bytes_model(
+            symbol_json, param_bytes, input_shapes)
+
+        def reload():
+            _fn, pv, _names = _loader.bytes_model(
+                symbol_json, param_bytes, input_shapes)
+            return pv
+
+        return self._install(name, fn, param_vals, input_names, reload)
+
+    def _install(self, name, fn, param_vals, input_names, loader):
+        with self._lock, _tsan.region(self, "registry"):
+            if name in self._models:
+                raise ValueError("model %r already registered (use swap "
+                                 "for a new weight version, or unload "
+                                 "first)" % name)
+            handle = ModelHandle(self, name, fn, param_vals, input_names,
+                                 loader=loader)
+            self._models[name] = handle
+            self.loads_total += 1
+            _tmetrics.serve_model_event("load")
+            self._evict_to_fit(protect=name)
+            self._publish_residency()
+            return handle
+
+    # -- use / residency -----------------------------------------------------
+    def get(self, name):
+        with self._lock:
+            return self._models.get(name)
+
+    def acquire(self, name):
+        """(handle, param_vals, version) snapshot for one dispatch:
+        atomic vs hot-swap, marks LRU use, transparently reloads an
+        evicted model (evicting others to fit).  The handle picks the
+        compiled entry per bucket (``jit_for``); params/version are the
+        torn-weight-free pair."""
+        with self._lock, _tsan.region(self, "registry"):
+            entry = self._models.get(name)
+            if entry is None:
+                raise KeyError("model %r is not registered" % name)
+            if not entry._resident:
+                if entry._loader is None:
+                    raise RuntimeError("model %r was evicted and has no "
+                                       "reload source" % name)
+                entry._params = dict(entry._loader())
+                entry._nbytes = _nbytes(entry._params)
+                entry._resident = True
+                self.reloads_total += 1
+                _tmetrics.serve_model_event("reload")
+                self._evict_to_fit(protect=name)
+                self._publish_residency()
+            self._models.move_to_end(name)
+            return entry, entry._params, entry._version
+
+    def unload(self, name):
+        """Drop a model entirely (its handle goes stale)."""
+        with self._lock, _tsan.region(self, "registry"):
+            entry = self._models.pop(name, None)
+            if entry is not None:
+                entry._params = {}
+                entry._resident = False
+                _tmetrics.serve_model_event("unload")
+                self._publish_residency()
+            return entry is not None
+
+    def evict(self, name):
+        """Explicitly drop a model's weights (keeps the handle; next use
+        reloads)."""
+        with self._lock, _tsan.region(self, "registry"):
+            entry = self._models.get(name)
+            if entry is None or not entry._resident:
+                return False
+            self._evict_entry(entry)
+            self._publish_residency()
+            return True
+
+    def _evict_entry(self, entry):
+        entry._params = {}
+        entry._resident = False
+        self.evictions_total += 1
+        _tmetrics.serve_model_event("evict")
+
+    def _evict_to_fit(self, protect=None):
+        """LRU-evict resident models until the budget holds.  The
+        ``protect``-ed (just-loaded/just-used) model is never evicted —
+        a single model bigger than the budget stays resident (it could
+        never serve otherwise); the gauges make the overshoot visible."""
+        if self._budget <= 0:
+            return
+        while self.resident_bytes() > self._budget:
+            victim = None
+            for entry in self._models.values():     # OrderedDict = LRU order
+                if entry._resident and entry.name != protect:
+                    victim = entry
+                    break
+            if victim is None:
+                return
+            self._evict_entry(victim)
+
+    def resident_bytes(self):
+        return sum(e._nbytes for e in self._models.values() if e._resident)
+
+    def _publish_residency(self):
+        _tmetrics.serve_residency(
+            self.resident_bytes(),
+            sum(1 for e in self._models.values() if e._resident),
+            self._budget)
+
+    # -- hot-swap ------------------------------------------------------------
+    def begin_swap(self, name, new_params):
+        """Start streaming a new weight version in: one local KVStore is
+        seeded with ``new_params`` and pulled via ``pull_many_async`` —
+        the async out-array writes stream while the CURRENT version
+        keeps serving.  Returns a :class:`SwapTicket`; nothing changes
+        until ``commit()``."""
+        from .. import kvstore as _kvstore
+        from ..ndarray import NDArray, zeros
+        import jax.numpy as jnp
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                raise KeyError("model %r is not registered" % name)
+            target_version = entry._version + 1
+        kv = _kvstore.KVStore("local")
+        keys, outs_list, outs = [], [], {}
+        for pname in sorted(new_params):
+            v = new_params[pname]
+            v = v if isinstance(v, NDArray) else NDArray(jnp.asarray(v))
+            key = pname
+            kv.init(key, v)
+            out = zeros(v.shape, dtype=np.dtype(v.dtype).name)
+            keys.append(key)
+            outs_list.append([out])
+            outs[pname] = out
+        handle = kv.pull_many_async(
+            keys, outs_list,
+            label="swap[%s v%d:%dp]" % (name, target_version, len(keys)))
+        return SwapTicket(self, name, target_version, outs, handle)
+
+    def swap(self, name, new_params):
+        """begin_swap + commit in one call.  Returns the new version."""
+        return self.begin_swap(name, new_params).commit()
+
+    def _commit_swap(self, name, new_params):
+        # the reload source must flip WITH the weights: any prior loader
+        # (original bytes, the source block's params) would resurrect
+        # pre-swap weights under the post-swap version after an
+        # eviction.  Host np copies keep the device arrays evictable.
+        host = {n: np.asarray(v) for n, v in new_params.items()}
+
+        def reload():
+            import jax.numpy as jnp
+            return {n: jnp.asarray(v) for n, v in host.items()}
+
+        with self._lock, _tsan.region(self, "registry"):
+            entry = self._models.get(name)
+            if entry is None:
+                raise KeyError("model %r was unloaded mid-swap" % name)
+            entry._params = new_params
+            entry._nbytes = _nbytes(new_params)
+            # monotonic bump at commit time: two overlapping swaps can
+            # never share or regress a version number
+            target_version = entry._version + 1
+            entry._version = target_version
+            entry._resident = True
+            entry._loader = reload
+            self.swaps_total += 1
+            _tmetrics.serve_model_event("swap")
+            self._evict_to_fit(protect=name)
+            self._publish_residency()
+            return target_version
+
+    # -- introspection -------------------------------------------------------
+    def models(self):
+        with self._lock:
+            return list(self._models.keys())
+
+    def stats(self):
+        with self._lock:
+            return {
+                "models": {
+                    n: {"version": e._version, "resident": e._resident,
+                        "nbytes": e._nbytes}
+                    for n, e in self._models.items()},
+                "resident_bytes": self.resident_bytes(),
+                "budget_bytes": self._budget,
+                "loads": self.loads_total,
+                "reloads": self.reloads_total,
+                "evictions": self.evictions_total,
+                "swaps": self.swaps_total,
+            }
+
+
+_default = [None]
+_default_lock = threading.Lock()
+
+
+def default_registry():
+    """The process-wide registry the legacy ``predict.Predictor``
+    surface registers into (one loader, shared residency accounting)."""
+    with _default_lock:
+        if _default[0] is None:
+            _default[0] = ModelRegistry()
+        return _default[0]

@@ -71,8 +71,8 @@ from collections import deque
 from . import metrics as _metrics
 
 __all__ = ["enabled", "set_enabled", "ring_size", "configure", "interval",
-           "phase", "io_wait", "comm", "step_end", "current_step", "steps",
-           "summary", "compact", "reset", "COMPONENTS", "ABBREV"]
+           "phase", "io_wait", "comm", "device", "step_end", "current_step",
+           "steps", "summary", "compact", "reset", "COMPONENTS", "ABBREV"]
 
 COMPONENTS = ("data_wait", "forward", "backward_compute", "exposed_comm",
               "optimizer_update", "host_gap")
@@ -137,7 +137,8 @@ class _ThreadState(object):
     (records from all threads share the ring)."""
 
     __slots__ = ("intervals", "prev_end", "completed", "io_n", "coll_n",
-                 "comm_blocked", "comm_inflight", "gen")
+                 "comm_blocked", "comm_inflight", "device_s", "device_n",
+                 "device_first", "gen")
 
     def __init__(self):
         self.intervals = []      # (category, t0, t1) in perf_counter secs
@@ -147,6 +148,11 @@ class _ThreadState(object):
         self.coll_n = 0
         self.comm_blocked = 0.0
         self.comm_inflight = 0.0
+        self.device_s = 0.0      # device-busy ledger (sync-mode flushes,
+        self.device_n = 0        #  serving batch dispatches)
+        self.device_first = None  # earliest device span start (the first
+        #                          window on a device-only thread starts
+        #                          here, not at step_end)
         self.gen = _generation[0]
 
     def reset_window(self):
@@ -154,6 +160,9 @@ class _ThreadState(object):
         self.prev_end = None
         self.io_n = self.coll_n = 0
         self.comm_blocked = self.comm_inflight = 0.0
+        self.device_s = 0.0
+        self.device_n = 0
+        self.device_first = None
         self.gen = _generation[0]
 
 
@@ -242,6 +251,26 @@ def comm(t0, t1, inflight=None):
         _append_interval(st, ("exposed_comm", t0, t1))
 
 
+def device(t0, t1):
+    """Book one DEVICE-busy span into the window's device ledger
+    (ROADMAP device-time lens carry-forward, PR 8).  Three sources
+    feed it: engine flushes and eager op dispatches under
+    ``profiler.sync`` (both block until ready, so dispatch→ready IS
+    device latency) and the serving runtime's batch dispatch
+    (issue → ``block_until_ready``).  Unlike the six host components
+    the device ledger is a PARALLEL decomposition: ``device_busy_s``
+    vs ``device_idle_s = wall - busy`` (its own exact-sum contract),
+    so comm/compute overlap is measurable on the device, not just as
+    host wall."""
+    if t1 <= t0 or not enabled():
+        return
+    st = _state()
+    st.device_s += t1 - t0
+    st.device_n += 1
+    if st.device_first is None:
+        st.device_first = t0
+
+
 def _attribute(intervals, w0, w1):
     """Sweep the window once: every elementary slice goes to the
     highest-priority category covering it.  Returns (per-category
@@ -287,6 +316,8 @@ def step_end(origin="step", extra=None):
     w0 = st.prev_end
     if w0 is None:      # first step: window starts at the first activity
         w0 = min((t0 for _c, t0, _t1 in st.intervals), default=now)
+        if st.device_first is not None:
+            w0 = min(w0, st.device_first)
     wall = max(now - w0, 0.0)
     comp, attributed = _attribute(st.intervals, w0, now)
     comp["host_gap"] = max(wall - attributed, 0.0)
@@ -303,12 +334,22 @@ def step_end(origin="step", extra=None):
         "io_waits": st.io_n,
         "thread": threading.current_thread().name,
     }
+    if st.device_n:
+        # device ledger: busy + idle == wall EXACTLY (idle is wall - busy
+        # by construction; busy clamps at wall — a span straddling the
+        # window boundary books whole into the window it completed in)
+        busy = min(st.device_s, wall)
+        rec["device"] = {"busy_s": busy, "idle_s": wall - busy,
+                         "spans": st.device_n}
     if extra:
         rec.update(extra)
     st.intervals = []
     st.prev_end = now
     st.io_n = st.coll_n = 0
     st.comm_blocked = st.comm_inflight = 0.0
+    st.device_s = 0.0
+    st.device_n = 0
+    st.device_first = None
     _ring.append(rec)
     _metrics.lens_step(rec)
     _maybe_report(rec)
@@ -323,6 +364,8 @@ def compact(rec):
         out[c + "_ms"] = round(rec["components"][c] * 1e3, 3)
     out["comm_blocked_ms"] = round(rec["comm_blocked_s"] * 1e3, 3)
     out["comm_inflight_ms"] = round(rec["comm_inflight_s"] * 1e3, 3)
+    if "device" in rec:
+        out["device_busy_ms"] = round(rec["device"]["busy_s"] * 1e3, 3)
     return out
 
 

@@ -657,6 +657,18 @@ def lens_step(rec):
                 "steps; how much comm the overlap hid)").set(
             max(0.0, min(1.0, 1.0 - rec["comm_blocked_s"]
                          / rec["comm_inflight_s"])))
+    dev = rec.get("device")
+    if dev is not None:
+        # device-time lens (PR 8 carry-forward): sync-mode flush spans /
+        # serving batch dispatches book true device latency per window
+        r.histogram("graft_lens_device_busy_seconds",
+                    "Per-step device-busy time (profiler sync-mode "
+                    "flushes + serving batch dispatches)", (),
+                    buckets=_PHASE_BUCKETS).observe(dev["busy_s"])
+        r.gauge("graft_lens_device_busy_fraction",
+                "Last device-bearing step's device-busy fraction of "
+                "wall (busy + idle == wall exactly)").set(
+            dev["busy_s"] / wall if wall > 0 else 0.0)
 
 
 # -- graftwatch: watchdog + dist liveness ------------------------------------
@@ -731,6 +743,118 @@ def lockstep_divergence():
     _REGISTRY.counter("graft_lockstep_divergence_total",
                       "Cross-rank collective-stream divergences detected"
                       ).inc()
+
+
+# -- graftserve: production serving runtime -----------------------------------
+
+_SERVE_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+_SERVE_LATENCY_BUCKETS = (1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5,
+                          1.0, 5.0)
+
+
+def serve_request(model, wall_s, components):
+    """One completed serving request: per-request latency + the four-way
+    decomposition (queue_wait/batch_assembly/device_compute/host_io,
+    serving/slo.py — the components sum EXACTLY to ``wall_s``)."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    r.counter("graft_serve_requests_total",
+              "Serving requests completed", ("model",)).inc(model=model)
+    r.histogram("graft_serve_request_seconds",
+                "End-to-end request latency (enqueue to response ready)",
+                ("model",), buckets=_SERVE_LATENCY_BUCKETS).observe(
+        wall_s, model=model)
+    h = r.histogram("graft_serve_component_seconds",
+                    "Per-request latency by SLO component", ("component",),
+                    buckets=_SERVE_LATENCY_BUCKETS)
+    for c, v in components.items():
+        h.observe(v, component=c)
+
+
+def serve_quantiles(p50_s, p99_s):
+    """Rolling-window latency quantiles (serving/slo.py recomputes them
+    over the request ring after every batch)."""
+    if not enabled():
+        return
+    g = _REGISTRY.gauge("graft_serve_latency_seconds",
+                        "Rolling request-latency quantiles over the last "
+                        "GRAFT_SERVE_RING requests", ("quantile",))
+    g.set(p50_s, quantile="p50")
+    g.set(p99_s, quantile="p99")
+
+
+def serve_batch(model, size, bucket):
+    """One dispatched serving batch: ``size`` real requests padded to
+    the ``bucket`` compiled batch signature."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    r.counter("graft_serve_batches_total",
+              "Serving batches dispatched", ("model",)).inc(model=model)
+    r.histogram("graft_serve_batch_size",
+                "Real requests per dispatched batch", (),
+                buckets=_SERVE_BATCH_BUCKETS).observe(size)
+    if bucket > size:
+        r.counter("graft_serve_padding_rows_total",
+                  "Padding rows dispatched to reach a batch bucket").inc(
+            bucket - size)
+
+
+def serve_queue_depth(depth):
+    """Requests currently queued across all models (set on every
+    enqueue/pick)."""
+    if not enabled():
+        return
+    _REGISTRY.gauge("graft_serve_queue_depth",
+                    "Requests waiting in the dynamic batcher").set(depth)
+
+
+def serve_errors(model, n=1):
+    """Requests failed by a dispatch/model error."""
+    if not enabled():
+        return
+    _REGISTRY.counter("graft_serve_errors_total",
+                      "Serving requests failed", ("model",)).inc(
+        n, model=model)
+
+
+def serve_model_event(kind):
+    """Registry lifecycle tick: ``load``/``reload``/``evict``/``swap``/
+    ``unload`` (serving/registry.py)."""
+    if not enabled():
+        return
+    _REGISTRY.counter("graft_serve_model_events_total",
+                      "Model registry lifecycle events (load/reload/"
+                      "evict/swap/unload)", ("kind",)).inc(kind=kind)
+
+
+def serve_residency(resident_bytes, resident_models, budget_bytes):
+    """Registry residency snapshot after every load/evict/swap."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    r.gauge("graft_serve_resident_bytes",
+            "Model weight bytes resident in the serving registry").set(
+        resident_bytes)
+    r.gauge("graft_serve_resident_models",
+            "Models with resident weights in the serving registry").set(
+        resident_models)
+    # always published (0 = unlimited) so an unlimited registry can't
+    # inherit a stale budget value from an earlier bounded one
+    r.gauge("graft_serve_memory_budget_bytes",
+            "GRAFT_SERVE_MEMORY_BYTES residency budget (0 = "
+            "unlimited)").set(budget_bytes)
+
+
+def serve_parity_fallback(model):
+    """One (model, shape, bucket) signature demoted to per-request
+    dispatch because its batched output failed the bit-parity probe."""
+    if not enabled():
+        return
+    _REGISTRY.counter("graft_serve_parity_fallbacks_total",
+                      "Batch signatures demoted to per-request dispatch "
+                      "by the parity probe", ("model",)).inc(model=model)
 
 
 _REGISTRY.register_collector(_collect_device_memory)

@@ -34,6 +34,12 @@
 #    silent.  graftlint --all (tier 1) now also runs the GL2xx static
 #    concurrency rules over the package sources; bench_eager --smoke
 #    reports tsan_overhead_pct (detector default-off; informational).
+# 7. graftserve smoke — serving --selftest drives threaded traffic
+#    through the dynamic batcher (bit-parity vs the eager forward, SLO
+#    conservation, atomic hot-swap, LRU residency), and
+#    bench_serving.py --smoke emits the serving BENCH JSON (p50/p99 vs
+#    offered QPS) asserting batched dispatch >= 3x the serial
+#    Module.predict loop with bit-equal outputs.
 #
 # Usage: tools/run_lint.sh [report.json]
 set -uo pipefail
@@ -50,5 +56,10 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_eager.py --smoke \
 python -m incubator_mxnet_tpu.telemetry --blackbox --selftest \
     || exit $?
 python -m incubator_mxnet_tpu.telemetry --analyze --selftest \
+    || exit $?
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m incubator_mxnet_tpu.serving --selftest \
+    || exit $?
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_serving.py --smoke \
     || exit $?
 exec python -m incubator_mxnet_tpu.telemetry --selftest
