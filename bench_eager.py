@@ -47,6 +47,11 @@ loop (handles issued/waited, scheduler regions, NDArray writes — every
 instrumented site firing) with the happens-before race detector on vs
 off.  The detector is DEFAULT-OFF, so the number is informational; the
 enabled-mode design bar is < 10%.
+
+Round 12 (graftpulse) adds ``pulse_overhead_pct``: a bulked ASYNC train
+loop (no sync mode — flush-boundary reaper enqueues and mem-timeline
+probes firing) with the async device-time ledger on vs off, each round
+draining the reaper inside its own window.  Same < 2% bar as the lens.
 """
 import json
 import sys
@@ -357,6 +362,70 @@ def _lens_overhead_bench(iters=20, repeats=4, n_params=8, shape=(16, 16)):
     }
 
 
+def _pulse_overhead_bench(iters=50, repeats=6, n_params=8, shape=(16, 16)):
+    """graftpulse async-ledger cost on a real bulked ASYNC train loop
+    (flush-boundary reaper enqueues + mem-timeline probes firing — the
+    graftpulse dispatch-site surface): the same loop timed with the
+    pulse ledger ON (the default) vs forced OFF, lens on throughout,
+    interleaved min-of-rounds with alternating mode order like the lens
+    bench.  Each timed round drains the reaper INSIDE its window so the
+    on-mode pays its full cost (a pending queue crossing into the off
+    round would book the on-mode's work to the off-mode's clock).  The
+    acceptance bar is < 2% (ISSUE 12)."""
+    import jax.numpy as jnp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon
+    from incubator_mxnet_tpu.telemetry import lens
+
+    rs = np.random.RandomState(0)
+    ps = []
+    for k in range(n_params):
+        p = gluon.Parameter("pob%d" % k, shape=shape)
+        p.initialize(ctx=mx.cpu())
+        p.data()._write(jnp.asarray(rs.randn(*shape).astype(np.float32)))
+        ps.append(p)
+    trainer = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01},
+                            kvstore=mx.kv.create("local"))
+
+    def loop():
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            with mx.engine.bulk(64):
+                with autograd.record():
+                    loss = None
+                    for p in ps:
+                        y = (p.data() * p.data()).sum()
+                        loss = y if loss is None else loss + y
+                loss.backward()
+            trainer.step(1)
+        ps[-1].data().asnumpy()
+        lens.pulse_drain(10.0)
+        return time.perf_counter() - t0
+
+    prev_lens = lens._enabled_override
+    prev_pulse = lens._pulse_override
+    lens.set_enabled(True)
+    try:
+        for _ in range(3):
+            loop()                               # warm compiles + plan
+        best = {True: float("inf"), False: float("inf")}
+        for r in range(repeats):
+            order = (False, True) if r % 2 == 0 else (True, False)
+            for state in order:
+                lens.set_pulse(state)
+                best[state] = min(best[state], loop())
+    finally:
+        lens.set_pulse(prev_pulse)
+        lens.set_enabled(prev_lens)
+        lens.reset()
+    pct = (best[True] - best[False]) / best[False] * 100.0
+    return {
+        "pulse_on_step_ms": round(best[True] / iters * 1e3, 3),
+        "pulse_off_step_ms": round(best[False] / iters * 1e3, 3),
+        "pulse_overhead_pct": round(pct, 2),
+    }
+
+
 def _tsan_overhead_bench(iters=20, repeats=4, n_params=8, shape=(16, 16)):
     """grafttsan enabled-mode cost on a real overlapped train loop —
     async reduce handles (issue/settle + value registry), scheduler
@@ -466,6 +535,7 @@ def smoke():
     res.update(_duplex_step_bench(iters=4, repeats=2))
     res.update(_blackbox_overhead_bench(iters=10, repeats=3))
     res.update(_lens_overhead_bench(iters=10, repeats=3))
+    res.update(_pulse_overhead_bench(iters=10, repeats=3))
     res.update(_tsan_overhead_bench(iters=8, repeats=2))
     res["metric"] = "fused_step_smoke"
     res["backend"] = jax.default_backend()
@@ -624,6 +694,9 @@ def main():
     # -- graftlens: attribution overhead on a real train loop (round 8) --
     lens_overhead = _lens_overhead_bench()
 
+    # -- graftpulse: async device-ledger overhead (round 12) -------------
+    pulse_overhead = _pulse_overhead_bench()
+
     # -- grafttsan: race-detector overhead, enabled mode (round 10) ------
     tsan_overhead = _tsan_overhead_bench()
 
@@ -633,6 +706,7 @@ def main():
         **duplex,
         **blackbox_overhead,
         **lens_overhead,
+        **pulse_overhead,
         **tsan_overhead,
         "metric": "eager_small_op_dispatch",
         "backend": backend,

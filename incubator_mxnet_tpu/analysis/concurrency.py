@@ -34,9 +34,15 @@ where the deviation is.
 Lock identity is heuristic by design: any ``with`` context whose dotted
 name's last segment contains ``lock`` (case-insensitive) is treated as
 a lock; ``self._x_lock`` keys on the enclosing class, module globals on
-the module.  The acquisition graph is LEXICAL (nested ``with`` blocks
-within one function) — call-chain acquisition is out of scope and
-documented as such in docs/static_analysis.md.
+the module.  The acquisition graph is lexical (nested ``with`` blocks
+within one function) PLUS one interprocedural call level (PR 12): a
+call made while holding lock A contributes an edge A → every lock the
+CALLEE's own body acquires.  Call resolution is deliberately
+conservative — ``self.meth(...)`` resolves to methods of the same
+class (cross-file when class names match, like lock identity), a bare
+``name(...)`` to same-module top-level functions; dotted/imported
+calls and deeper chains stay out of scope (documented in
+docs/static_analysis.md).
 """
 from __future__ import annotations
 
@@ -112,11 +118,36 @@ class _FileFacts(object):
         self.lock_edges = []        # (held_key, inner_key, line)
         self.lock_sites = {}        # key -> first (file, line)
         self.findings = []          # (code, line, message)
+        self.fn_locks = {}          # callee key -> set(lock keys its own
+        #                             body acquires); callee keys are
+        #                             ("c", ClassName, meth) for methods,
+        #                             ("m", module, name) for top-level
+        #                             functions
+        self.held_calls = []        # (held tuple, callee key, line) —
+        #                             calls made while holding a lock
+        #                             (the one-level interprocedural
+        #                             GL201 inputs)
 
 
-def _walk_locks(body, held, facts, module, cls):
+def _callee_key(call, module, cls):
+    """Conservative identity of a called function for the one-level
+    lock propagation, or None for anything we will not resolve
+    (imported/dotted calls, computed callees)."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        return ("m", module, func.id)
+    if isinstance(func, ast.Attribute) \
+            and isinstance(func.value, ast.Name) \
+            and func.value.id == "self" and cls is not None:
+        return ("c", cls, func.attr)
+    return None
+
+
+def _walk_locks(body, held, facts, module, cls, fn_key=None):
     """Lexical lock-nesting walk: record an edge held -> new for every
-    ``with`` whose context looks like a lock."""
+    ``with`` whose context looks like a lock, the set of locks each
+    function's own body acquires, and every call made under a held
+    lock (the interprocedural one-level inputs)."""
     for node in body:
         new_held = held
         if isinstance(node, (ast.With, ast.AsyncWith)):
@@ -131,16 +162,35 @@ def _walk_locks(body, held, facts, module, cls):
                     for h in new_held:
                         if h != key:
                             facts.lock_edges.append((h, key, node.lineno))
+                    if fn_key is not None:
+                        facts.fn_locks.setdefault(fn_key, set()).add(key)
                     acquired.append(key)
             new_held = held + tuple(acquired)
         if isinstance(node, ast.ClassDef):
             _walk_locks(node.body, (), facts, module, node.name)
             continue
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            _walk_locks(node.body, (), facts, module, cls)
+            if fn_key is not None:
+                # a def NESTED inside a function: key it off the callee
+                # namespace ("x" kind is unreachable from _callee_key)
+                # — merging a local closure's lock summary with a
+                # same-named top-level function or method elsewhere
+                # would fabricate interprocedural edges and false GL201
+                # cycles
+                sub_key = ("x", fn_key, node.name)
+            elif cls is not None:
+                sub_key = ("c", cls, node.name)
+            else:
+                sub_key = ("m", module, node.name)
+            facts.fn_locks.setdefault(sub_key, set())
+            _walk_locks(node.body, (), facts, module, cls, fn_key=sub_key)
             continue
+        if isinstance(node, ast.Call) and new_held:
+            callee = _callee_key(node, module, cls)
+            if callee is not None:
+                facts.held_calls.append((new_held, callee, node.lineno))
         _walk_locks(list(ast.iter_child_nodes(node)), new_held, facts,
-                    module, cls)
+                    module, cls, fn_key=fn_key)
 
 
 def _is_thread_call(call):
@@ -339,6 +389,22 @@ def _diagnostics(facts_list, suppress_by_file):
         for a, b, line in facts.lock_edges:
             all_edges.append((a, b, line))
             sites.setdefault((a, b), (facts.filename, line))
+    # interprocedural one-level propagation: a call under lock A to a
+    # function whose own body acquires B is an A -> B edge, exactly as
+    # if the body were inlined one level (deeper chains stay out of
+    # scope — the summaries are per-body, not transitive)
+    fn_locks = {}
+    for facts in facts_list:
+        for key, locks in facts.fn_locks.items():
+            fn_locks.setdefault(key, set()).update(locks)
+    for facts in facts_list:
+        for held, callee, line in facts.held_calls:
+            for inner in fn_locks.get(callee, ()):
+                for h in held:
+                    if h != inner:
+                        all_edges.append((h, inner, line))
+                        sites.setdefault((h, inner),
+                                         (facts.filename, line))
     for cycle in _find_cycles(all_edges):
         first = sites.get((cycle[0], cycle[1]),
                           (facts_list[0].filename if facts_list else "?", 1))

@@ -44,9 +44,9 @@ import threading
 import zlib
 from collections import deque
 
-__all__ = ["enabled", "set_enabled", "fold", "state", "observe",
-           "note_order", "divergence", "table", "snapshot", "reset",
-           "keys_digest", "EXCLUDED_PATHS", "TABLE_SIZE"]
+__all__ = ["enabled", "set_enabled", "fold", "state", "state_lagged",
+           "observe", "note_order", "divergence", "table", "snapshot",
+           "reset", "keys_digest", "lag", "EXCLUDED_PATHS", "TABLE_SIZE"]
 
 # host parameter-service RPCs are rank-asymmetric by design (async SGD)
 EXCLUDED_PATHS = frozenset(["ps_push", "ps_pull", "ps_push_async"])
@@ -131,6 +131,41 @@ def state():
         return _folds[0], _rolling[0]
 
 
+def lag():
+    """GRAFT_LOCKSTEP_LAG (default 8): how many folds behind the head
+    the lagged-prefix sample trails."""
+    try:
+        n = int(os.environ.get("GRAFT_LOCKSTEP_LAG", "8"))
+    except ValueError:
+        return 8
+    return max(n, 1)
+
+
+def state_lagged():
+    """(fold_count, rolling_hash, lag_fold, lag_hash) — the head pair
+    PLUS the rolling hash as it stood ``lag()`` folds ago (read from the
+    divergence table).  ONLINE BISECTION (PR 10 carry-forward): with two
+    prefix points per heartbeat accumulating in every peer's ``_seen``
+    table, :func:`observe` can bracket a divergence between the last
+    MATCHING fold and the first MISMATCHING one — when they are
+    adjacent, the exact divergent collective is pinned online, not only
+    in offline ``--analyze``.  ``(0, 0)`` lag halves ship while the
+    stream is shorter than the lag (peers skip zero folds)."""
+    with _lock:
+        head_fold, head_hash = _folds[0], _rolling[0]
+        want = head_fold - lag()
+        lag_fold, lag_hash = 0, 0
+        if want > 0:
+            for fi, _s, _p, _nk, _nb, _d, r in reversed(_table):
+                if fi == want:
+                    lag_fold, lag_hash = want, r
+                    break
+                if fi < want:
+                    break       # evicted from the bounded table: ship
+                    #             nothing rather than a fabricated hash
+        return head_fold, head_hash, lag_fold, lag_hash
+
+
 def divergence():
     """The first detected divergence record, or None."""
     return _divergence[0]
@@ -150,8 +185,9 @@ def table(last=None):
 
 
 def observe(rank_table, my_rank=None):
-    """Cross-check one heartbeat's per-rank ``{rank: (fold_count,
-    hash)}``.
+    """Cross-check one heartbeat's per-rank ``{rank: (fold_count, hash)}``
+    or ``{rank: (fold_count, hash, lag_fold, lag_hash)}`` (the
+    lagged-prefix pair :func:`state_lagged` ships).
 
     Two detectors, both keyed on the rank-comparable FOLD index:
 
@@ -167,6 +203,13 @@ def observe(rank_table, my_rank=None):
       must equal our recorded rolling at fold F — a mere laggard
       matches, a diverged stream does not.
 
+    ONLINE BISECTION: the lagged-prefix points double the sampled
+    prefix density, and the report brackets the divergence between the
+    peer's last MATCHING fold and first MISMATCHING one.  When the two
+    are adjacent the report is ``pinned`` and carries the local table's
+    ``divergent_collective`` row (path, keys digest, nbytes) — the
+    exact collective, named online.
+
     The first divergence is reported once: a ``lockstep_divergence``
     flight-recorder event carrying the per-rank hashes, the local
     recent table, and the rank(s) disagreeing with the local stream.
@@ -175,11 +218,14 @@ def observe(rank_table, my_rank=None):
         return None
     report = None
     with _lock:
-        for rank, (fold, h) in rank_table.items():
-            fold = int(fold)
-            if fold <= 0:
-                continue
-            _seen.setdefault(fold, {})[int(rank)] = int(h)
+        for rank, entry in rank_table.items():
+            points = [(int(entry[0]), int(entry[1]))]
+            if len(entry) >= 4:
+                points.append((int(entry[2]), int(entry[3])))
+            for fold, h in points:
+                if fold <= 0:
+                    continue
+                _seen.setdefault(fold, {})[int(rank)] = h
         while len(_seen) > _SEEN_SEQS:
             del _seen[min(_seen)]
         if _divergence[0] is None:
@@ -189,6 +235,33 @@ def observe(rank_table, my_rank=None):
     if report is not None:
         _emit(report)
     return report
+
+
+def _pin_locked(local_at, rank, first_bad):
+    """Bisect one peer's divergence against the local stream: the last
+    fold (< first_bad) where the peer's sampled hash MATCHES the local
+    rolling brackets the divergence from below.  Adjacent bounds pin the
+    exact collective — the local table row at ``first_bad`` IS the first
+    collective the streams disagree on.  Returns (last_match_fold|None,
+    pinned, collective-row|None)."""
+    last_match = None
+    for fold, ranks in _seen.items():
+        h = ranks.get(int(rank))
+        if h is None or fold >= first_bad:
+            continue
+        if local_at.get(fold) == h:
+            last_match = fold if last_match is None \
+                else max(last_match, fold)
+    pinned = last_match is not None and last_match == first_bad - 1
+    row = None
+    if pinned:
+        for fi, s, p, nk, nb, d, r in _table:
+            if fi == first_bad:
+                row = {"fold": fi, "seq": s, "path": p, "n_keys": nk,
+                       "nbytes": nb, "digest": d}
+                break
+        pinned = row is not None
+    return last_match, pinned, row
 
 
 def _first_divergence_locked(my_rank):
@@ -202,12 +275,19 @@ def _first_divergence_locked(my_rank):
                 continue
             mine = local_at.get(fold)
             if mine is not None and mine != h:
-                return {
+                last_match, pinned, row = _pin_locked(local_at, rank,
+                                                      fold)
+                report = {
                     "first_divergent_fold": fold,
+                    "last_matching_fold": last_match,
+                    "pinned": pinned,
                     "rank_hashes": {str(rank): h, str(my_rank): mine},
                     "divergent_ranks": [int(rank)],
                     "observer_rank": my_rank,
                 }
+                if row is not None:
+                    report["divergent_collective"] = row
+                return report
     # exact-position cross-peer match (covers folds our table evicted)
     for fold in sorted(_seen):
         ranks = _seen[fold]
@@ -223,6 +303,8 @@ def _first_divergence_locked(my_rank):
                 my_hash = max(counts, key=counts.get)
             return {
                 "first_divergent_fold": fold,
+                "last_matching_fold": None,
+                "pinned": False,
                 "rank_hashes": {str(r): v
                                 for r, v in sorted(ranks.items())},
                 "divergent_ranks": sorted(r for r, v in ranks.items()
@@ -245,14 +327,25 @@ def _emit(report):
     except Exception:
         pass
     import logging
+    if report.get("pinned"):
+        c = report["divergent_collective"]
+        logging.getLogger("graftlockstep").error(
+            "LOCKSTEP DIVERGENCE: rank(s) %s issued a different "
+            "collective stream — PINNED to fold %d: %s (wire seq %s, "
+            "n_keys %s, nbytes %s, keys digest %s); per-rank rolling "
+            "hashes %s.",
+            report["divergent_ranks"], report["first_divergent_fold"],
+            c["path"], c["seq"], c["n_keys"], c["nbytes"], c["digest"],
+            report["rank_hashes"])
+        return
     logging.getLogger("graftlockstep").error(
         "LOCKSTEP DIVERGENCE: rank(s) %s issued a different collective "
-        "stream — first divergent stream position (fold) <= %d (per-rank "
-        "rolling hashes %s). The wire will mispair; dump the flight "
-        "recorders and run `telemetry --analyze` on them to name the "
-        "exact collective.",
+        "stream — first divergent stream position (fold) <= %d (last "
+        "matching fold %s; per-rank rolling hashes %s). The wire will "
+        "mispair; dump the flight recorders and run `telemetry "
+        "--analyze` on them to name the exact collective.",
         report["divergent_ranks"], report["first_divergent_fold"],
-        report["rank_hashes"])
+        report.get("last_matching_fold"), report["rank_hashes"])
 
 
 def note_order(path, issue_idx):

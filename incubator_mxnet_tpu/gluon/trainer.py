@@ -102,6 +102,10 @@ class Trainer(object):
         self._pull_scheduler = _overlap.PullScheduler()
         self._bucket_lateness = {}      # param idx -> blocked-wait EWMA
         #                                 (tape-order packing tie-breaker)
+        # graftpulse: the trainer is a bucket-bytes / bucket-order
+        # target for the lens-driven autotuner (weak registration)
+        from ..telemetry import autotune as _autotune
+        _autotune.register_trainer(self)
 
     def _check_contexts(self):
         contexts = None
@@ -649,6 +653,7 @@ class Trainer(object):
         _overlap.publish_pull_round(self._pull_scheduler)
         all_keys = [i for b in buckets for i in b.indices]
         overlap = self._pull_overlap_ok(all_keys, pull_stale)
+        from ..telemetry import lens as _lens
         for b in buckets:
             flat = reduced[id(b)]
             shapes = [self._params[i].shape for i in b.indices]
@@ -657,6 +662,9 @@ class Trainer(object):
                 list(b.indices),
                 [NDArray(piece, ctx=self._contexts[0])
                  for piece in pieces])
+            # graftpulse memory timeline: each bucket's store-side apply
+            # is an allocation-watermark sample point
+            _lens.mem_sample(self._sched_label(b))
             if overlap:
                 # THIS bucket's weights go back on the wire before the
                 # next bucket updates — the full-duplex stream
@@ -676,6 +684,7 @@ class Trainer(object):
     def _bucketed_update(self, plan, reduced):
         """One fused multi-tensor optimizer dispatch per (bucket,
         context); leftover params take the per-param updater."""
+        from ..telemetry import lens as _lens
         buckets, leftover = plan
         optimizer = self._optimizer
         n_ctx = len(self._contexts)
@@ -709,6 +718,9 @@ class Trainer(object):
                 opt.fused_bucket_update(optimizer, self._updaters[j],
                                         b.indices, weights, grads,
                                         lrs[j], wds[j], flat_grad=fg)
+            # graftpulse memory timeline: per-bucket watermark after the
+            # fused update dispatch (the future memory planner's signal)
+            _lens.mem_sample(self._sched_label(b))
         for i in leftover:
             param = self._params[i]
             for upd, arr, grad in zip(self._updaters, param.list_data(),

@@ -762,6 +762,7 @@ def invoke(op: Operator, inputs, params, out=None):
                               args={"device_time": _profiler.want_sync()})
     if _span is not None:
         _span.__enter__()
+    _pulse = _lens.pulse_active()
     _t_dispatch = None
     try:
         if recording:
@@ -778,7 +779,7 @@ def invoke(op: Operator, inputs, params, out=None):
             out_vals, vjp_fn = jax.vjp(wrapped, *vals)
         else:
             fn = op.bind(params, is_train)
-            if _span is not None:
+            if _span is not None or _pulse:
                 _t_dispatch = _time.perf_counter()  # after bind: the
                 #                                     executing call only
             out_vals = fn(*vals, **kw)
@@ -789,6 +790,7 @@ def invoke(op: Operator, inputs, params, out=None):
         if _span is not None:
             _span.__exit__(type(exc), exc, None)
         raise
+    _sync_booked = False
     if _span is not None:
         if _profiler.want_sync():
             # device-time lens: under sync mode dispatch→ready IS this
@@ -796,11 +798,22 @@ def invoke(op: Operator, inputs, params, out=None):
             # flushes feed, so eager (unbulked) steps decompose too.
             # Recorded ops book the blocking wait only (_t_dispatch is
             # None there); cache-miss calls still include jit compile
+            _sync_booked = True
             _t_block = _time.perf_counter()
             jax.block_until_ready(out_vals)
             _lens.device(_t_dispatch if _t_dispatch is not None
                          else _t_block, _time.perf_counter())
         _span.__exit__()
+    if _pulse and not _sync_booked:
+        # graftpulse: async eager dispatch — hand the results to the
+        # reaper so dispatch→device-done books into this thread's device
+        # ledger without blocking here.  Recorded ops carry no clean
+        # dispatch instant (host tracing above): the post-call instant
+        # starts their span — an undercount, never host work booked as
+        # device time.  The sync path above books directly and skips
+        # the enqueue (no-double-booking contract).
+        _lens.device_async(out_vals, _t_dispatch if _t_dispatch is not None
+                           else _time.perf_counter())
     if _NAIVE_ENGINE:
         jax.block_until_ready(out_vals)
     first = out_vals[0] if isinstance(out_vals, tuple) else out_vals

@@ -680,29 +680,36 @@ class DistKVStore(KVStore):
 
         With GRAFT_LOCKSTEP_CHECK on (default; set it IDENTICALLY on
         every rank — the vector SHAPE depends on it) the vector widens
-        to (4W,) and additionally carries each rank's collective-stream
+        to (6W,) and additionally carries each rank's collective-stream
         rolling hash + FOLD COUNT (the audited-stream position, NOT the
         wire seq — ps_* brackets skew wire seqs rank-dependently; see
-        analysis/lockstep.py): every rank then cross-checks the table
-        and a rank whose stream diverged is named — with the first
-        divergent stream position — BEFORE a mispaired collective turns
-        into a silent hang."""
+        analysis/lockstep.py) PLUS the lagged-prefix pair (the rolling
+        hash as it stood GRAFT_LOCKSTEP_LAG folds earlier): every rank
+        then cross-checks the table and a rank whose stream diverged is
+        named BEFORE a mispaired collective turns into a silent hang —
+        and when the accumulated prefix points bracket the divergence
+        to adjacent folds, observe() pins the EXACT collective online
+        (PR 10's online-bisection carry-forward)."""
         W = num_workers()
         self._hb_step += 1
         now_ms = int(time.time() * 1000) % (1 << 31)
         audit = _lockstep.enabled()
-        vec = np.zeros(((4 if audit else 2) * W,), np.int32)
+        vec = np.zeros(((6 if audit else 2) * W,), np.int32)
         vec[rank()] = now_ms
         vec[W + rank()] = self._hb_step % (1 << 31)
         if audit:
-            folds, rolling = _lockstep.state()
+            folds, rolling, lag_fold, lag_hash = _lockstep.state_lagged()
             vec[2 * W + rank()] = rolling
             vec[3 * W + rank()] = folds % (1 << 31)
+            vec[4 * W + rank()] = lag_hash
+            vec[5 * W + rank()] = lag_fold % (1 << 31)
         out = np.asarray(_global_sum(jnp.asarray(vec))).astype(np.int64)
         ts_ms, steps = out[:W], out[W:2 * W]
         if audit:
-            hashes, folds_by_rank = out[2 * W:3 * W], out[3 * W:]
-            _lockstep.observe({r: (int(folds_by_rank[r]), int(hashes[r]))
+            hashes, folds_by_rank = out[2 * W:3 * W], out[3 * W:4 * W]
+            lag_hashes, lag_folds = out[4 * W:5 * W], out[5 * W:]
+            _lockstep.observe({r: (int(folds_by_rank[r]), int(hashes[r]),
+                                   int(lag_folds[r]), int(lag_hashes[r]))
                                for r in range(W)}, my_rank=rank())
         # mod-wrap unwrap: a rank that crossed the 2^31 ms boundary while
         # others have not would otherwise read as ~24 days of skew

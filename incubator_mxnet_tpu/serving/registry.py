@@ -111,13 +111,16 @@ class ModelHandle(object):
 
     __slots__ = ("name", "input_names", "_fn", "_jit", "_exact_jits",
                  "_params", "_version", "_resident", "_loader", "_nbytes",
-                 "_registry", "loaded_at", "parity_ok", "no_batch",
-                 "__weakref__")
+                 "_registry", "_loading", "loaded_at", "parity_ok",
+                 "no_batch", "__weakref__")
 
     def __init__(self, registry, name, fn, param_vals, input_names,
                  loader=None):
         import jax
         self._registry = registry
+        self._loading = None        # per-entry reload latch (an Event
+        #                             while one thread runs the loader
+        #                             OUTSIDE the registry lock)
         self.name = name
         self.input_names = list(input_names)
         self._fn = fn
@@ -326,24 +329,86 @@ class ModelRegistry(object):
         atomic vs hot-swap, marks LRU use, transparently reloads an
         evicted model (evicting others to fit).  The handle picks the
         compiled entry per bucket (``jit_for``); params/version are the
-        torn-weight-free pair."""
-        with self._lock, _tsan.region(self, "registry"):
-            entry = self._models.get(name)
-            if entry is None:
-                raise KeyError("model %r is not registered" % name)
-            if not entry._resident:
+        torn-weight-free pair.
+
+        Reload runs OUTSIDE the registry lock (ROADMAP 11e): a cold
+        model's loader — potentially seconds of parse + H2D — must not
+        stall other models' dispatches.  A per-entry latch serializes
+        concurrent reloads of the SAME model (one loader run, everyone
+        else waits on the Event, never on the lock); the install step
+        re-checks under the lock so a hot-swap or unload that raced the
+        reload wins (its weights are newer than the reload source's)."""
+        while True:
+            with self._lock, _tsan.region(self, "registry"):
+                entry = self._models.get(name)
+                if entry is None:
+                    raise KeyError("model %r is not registered" % name)
+                if entry._resident:
+                    self._models.move_to_end(name)
+                    return entry, entry._params, entry._version
                 if entry._loader is None:
                     raise RuntimeError("model %r was evicted and has no "
                                        "reload source" % name)
-                entry._params = dict(entry._loader())
-                entry._nbytes = _nbytes(entry._params)
-                entry._resident = True
-                self.reloads_total += 1
-                _tmetrics.serve_model_event("reload")
-                self._evict_to_fit(protect=name)
-                self._publish_residency()
-            self._models.move_to_end(name)
-            return entry, entry._params, entry._version
+                latch = entry._loading
+                if latch is None:
+                    latch = entry._loading = threading.Event()
+                    i_load = True
+                else:
+                    i_load = False
+                loader = entry._loader
+            if not i_load:
+                # another thread is mid-reload: wait on ITS latch (not
+                # the registry lock — other models keep dispatching),
+                # then re-check from the top
+                latch.wait()
+                continue
+            # the latch MUST open on every exit from here on — any
+            # escaping exception (loader failure, a malformed params
+            # mapping breaking _nbytes, a racing-unload KeyError) would
+            # otherwise park every follower in latch.wait() forever
+            try:
+                params = dict(loader())         # the slow part: unlocked
+                retry = False
+                with self._lock, _tsan.region(self, "registry"):
+                    entry._loading = None
+                    current = self._models.get(name)
+                    if current is entry and not entry._resident:
+                        # a swap/unload that raced us wins: only install
+                        # when the entry is still the one we loaded for
+                        # AND still cold (commit_swap set fresher
+                        # weights + resident)
+                        entry._params = params
+                        entry._nbytes = _nbytes(params)
+                        entry._resident = True
+                        self.reloads_total += 1
+                        _tmetrics.serve_model_event("reload")
+                        self._evict_to_fit(protect=name)
+                        self._publish_residency()
+                    if current is None:
+                        raise KeyError("model %r was unloaded mid-reload"
+                                       % name)
+                    if current is not entry:
+                        retry = True    # re-registered under the same
+                        #                 name mid-reload: serve the NEW
+                        #                 model (re-check from the top)
+                    else:
+                        self._models.move_to_end(name)
+                        snap = (entry, entry._params, entry._version)
+            except BaseException:
+                with self._lock:
+                    if entry._loading is latch:
+                        # clear only OUR latch: a failure past the
+                        # install step already cleared it, and a
+                        # successor may have installed a new one —
+                        # nulling that would let a third thread start a
+                        # duplicate loader run
+                        entry._loading = None
+                raise
+            finally:
+                latch.set()
+            if retry:
+                continue
+            return snap
 
     def unload(self, name):
         """Drop a model entirely (its handle goes stale)."""

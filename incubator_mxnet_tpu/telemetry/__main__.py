@@ -301,7 +301,7 @@ def _demo_lens_steps(n_steps=6):
     kvstore (exposed_comm) and the fused update — fills the lens ring."""
     import numpy as np
     import incubator_mxnet_tpu as mx
-    from incubator_mxnet_tpu import autograd, gluon, io
+    from incubator_mxnet_tpu import autograd, engine, gluon, io
     from incubator_mxnet_tpu.telemetry import lens
 
     prev = lens._enabled_override
@@ -320,12 +320,15 @@ def _demo_lens_steps(n_steps=6):
         it = io.NDArrayIter(data=x, label=y, batch_size=4)
         for batch in it:
             data = batch.data[0]
-            with autograd.record():
-                out = net(data)
-                loss = (out * out).mean()
-            loss.backward()
+            with engine.bulk(64):       # flush boundaries light the
+                #                         pulse + memory-timeline sites
+                with autograd.record():
+                    out = net(data)
+                    loss = (out * out).mean()
+                loss.backward()
             trainer.step(batch_size=data.shape[0])
             loss.asnumpy()
+        lens.pulse_drain(2.0)           # settle async ledger bookings
         return lens.steps()
     finally:
         lens.set_enabled(prev)
@@ -449,6 +452,86 @@ def _render_analyze_text(report):
     return "\n".join(lines)
 
 
+def _render_ingest_text(report):
+    lines = ["graftpulse device-ledger ingestion", "=" * 60]
+    lines.append("device-busy spans: %d" % report["device_events"])
+    lines.append("%-8s %10s %10s %10s %7s %6s"
+                 % ("step", "wall(ms)", "busy(ms)", "idle(ms)", "busy%",
+                    "spans"))
+    for r in report["steps"]:
+        lines.append("%-8s %10.3f %10.3f %10.3f %6.1f%% %6d"
+                     % (r["step"] if r["step"] is not None else "-",
+                        r["wall_s"] * 1e3, r["busy_s"] * 1e3,
+                        r["idle_s"] * 1e3, r["busy_fraction"] * 100,
+                        r["spans"]))
+    t = report["total"]
+    lines.append("total    %10.3f %10.3f %10.3f %6.1f%%"
+                 % (t["wall_s"] * 1e3, t["busy_s"] * 1e3,
+                    t["idle_s"] * 1e3, t["busy_fraction"] * 100))
+    for p in report["problems"]:
+        lines.append("PROBLEM: %s" % p)
+    return "\n".join(lines)
+
+
+def run_ingest(path, as_json):
+    from incubator_mxnet_tpu.telemetry import aggregate
+    report = aggregate.ingest_xla(path)
+    if as_json:
+        print(json.dumps(report, indent=2, sort_keys=True, default=str))
+    else:
+        print(_render_ingest_text(report))
+    return 1 if report["problems"] else 0
+
+
+def _demo_mem_steps():
+    """The --steps demo loop with the exact live-arrays memory sampler
+    installed (host CPU reports no allocator counters, so the default
+    per-flush sampler would auto-disable)."""
+    from incubator_mxnet_tpu.telemetry import lens
+    lens.set_mem_sampler(lens.live_arrays_sampler)
+    try:
+        records = _demo_lens_steps()
+    finally:
+        lens.set_mem_sampler(None)
+    return records, lens.mem_summary()
+
+
+def _render_mem_text(records, sites):
+    lines = ["graftpulse memory timeline (per-site allocation watermarks)",
+             "=" * 72]
+    lines.append("%-32s %8s %14s %14s"
+                 % ("site", "samples", "peak(bytes)", "last-in-use"))
+    for site in sorted(sites, key=lambda s: -sites[s]["peak_bytes"]):
+        s = sites[site]
+        lines.append("%-32s %8d %14d %14d"
+                     % (site[:32], s["samples"], s["peak_bytes"],
+                        s["last_in_use"]))
+    lines.append("")
+    lines.append("per-step window peaks:")
+    lines.append("%-5s %-8s %9s %14s %6s" % ("step", "origin", "wall(ms)",
+                                             "mem-peak(bytes)", "sites"))
+    for r in records:
+        mem = r.get("mem") or {}
+        lines.append("%-5d %-8s %9.2f %14s %6d"
+                     % (r["step"], r["origin"], r["wall_s"] * 1e3,
+                        mem.get("peak_bytes", "-"),
+                        len(mem.get("sites", ()))))
+    return "\n".join(lines)
+
+
+def run_mem(as_json):
+    records, sites = _demo_mem_steps()
+    if as_json:
+        print(json.dumps({"sites": sites,
+                          "steps": [{"step": r["step"],
+                                     "mem": r.get("mem")}
+                                    for r in records]},
+                         indent=2, sort_keys=True, default=str))
+    else:
+        print(_render_mem_text(records, sites))
+    return 0 if sites else 1
+
+
 def run_analyze(paths, merged_out, as_json):
     from incubator_mxnet_tpu.telemetry import aggregate
     report, _trace = aggregate.analyze(paths, merged_out=merged_out)
@@ -529,6 +612,15 @@ def main(argv=None):
     ap.add_argument("--steps", action="store_true",
                     help="run a short training loop and render the "
                          "graftlens per-step attribution ring")
+    ap.add_argument("--mem", action="store_true",
+                    help="run the demo loop with the exact memory "
+                         "sampler and render the graftpulse per-site "
+                         "allocation-watermark timeline")
+    ap.add_argument("--ingest-xla", metavar="TRACE", dest="ingest_xla",
+                    help="rebuild the per-step device ledger offline "
+                         "from a chrome trace (the async-ledger "
+                         "fallback when pulse callbacks were "
+                         "unavailable)")
     ap.add_argument("--top", type=int,
                     default=int(os.environ.get("GRAFT_TELEMETRY_TOPK",
                                                "10")),
@@ -545,8 +637,14 @@ def main(argv=None):
             ap.error("--analyze needs artifact PATHs (or --selftest)")
         return run_analyze(args.analyze, args.merged, args.json)
 
+    if args.ingest_xla:
+        return run_ingest(args.ingest_xla, args.json)
+
     if args.steps:
         return run_steps(args.json)
+
+    if args.mem:
+        return run_mem(args.json)
 
     if args.blackbox is not None:
         if args.selftest:

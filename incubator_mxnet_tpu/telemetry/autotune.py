@@ -1,0 +1,523 @@
+"""graftpulse autotuner — the first closed loop over the lens signals.
+
+Every signal graftlens ships (``data_wait`` fraction, the
+``comm_hidden_ratio``, straggler lateness) was read by HUMANS until now.
+This controller closes the loop (ROADMAP "lens-driven autotuning", PR 8
+carry-forward), guarded and default-off (``GRAFT_AUTOTUNE``):
+
+* **data_wait → DataLoader workers** — when a decision window's mean
+  ``data_wait`` fraction exceeds ``GRAFT_AUTOTUNE_DATA_WAIT`` (default
+  0.15), the registered loader's worker count doubles (capped at
+  ``GRAFT_AUTOTUNE_MAX_WORKERS``, default 8) via
+  ``DataLoader.set_num_workers`` — the pool grows IN PLACE and the
+  epoch iterator tops its lookahead up mid-epoch, so a starved loop
+  recovers without an epoch boundary.
+
+* **comm_hidden_ratio → GRAFT_BUCKET_BYTES** — when the window's
+  hidden-comm ratio (1 - blocked/in-flight collective time) sags below
+  ``GRAFT_AUTOTUNE_COMM_HIDDEN`` (default 0.5), the bucket target
+  hill-climbs: first SHRINK (smaller buckets close earlier in backward
+  → earlier issue → more overlap window); if a move makes the ratio
+  worse, the direction flips (bigger buckets amortize per-collective
+  latency better on some wires).  Bounds:
+  ``GRAFT_AUTOTUNE_MIN/MAX_BUCKET_BYTES`` (256 KiB / 64 MiB).  The knob
+  is the ``GRAFT_BUCKET_BYTES`` env var itself — the Trainer re-reads
+  it per step and its plan signature includes the target, so the next
+  step re-packs (one serial fallback step per re-plan, the documented
+  plan-change rail).
+
+* **straggler lateness → bucket order** — :func:`feed_straggler_table`
+  accepts ``telemetry/aggregate.py``'s straggler rows (or any
+  ``{"label", "lateness_s"}`` list) and feeds each named bucket's
+  lateness into the owning Trainer's per-param blocked-wait EWMA
+  (``_note_bucket_lateness``) — the tape-order packing tie-breaker —
+  then drops the plan caches so the next plan re-packs systematically
+  late buckets earlier (``_plan_order``).
+
+Every decision is journaled as a flight-recorder ``autotune_decision``
+event (signal, knob, old → new, cooldown) and mirrored to
+``graft_autotune_*`` metrics, so the controller is itself observable.
+Decisions are guarded by a per-knob COOLDOWN (``GRAFT_AUTOTUNE_COOLDOWN``
+windows, default 2) so an adjustment's effect lands in the signals
+before the next move — no oscillation on a noisy window.
+
+Wiring: ``DataLoader``/``Trainer`` register themselves (weakly) at
+construction; the controller observes finalized lens records through
+``lens.add_observer``.  With ``GRAFT_AUTOTUNE`` unset/0 the observer
+returns immediately and nothing else runs — bit-identical behavior.
+
+``python -m incubator_mxnet_tpu.telemetry.autotune --selftest`` runs the
+synthetic starved-DataLoader scenario (tools/run_lint.sh tier): the
+controller must grow workers until the data_wait fraction drops below
+the bound within a bounded number of steps.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+
+from . import blackbox as _blackbox
+from . import lens as _lens
+from . import metrics as _metrics
+
+__all__ = ["enabled", "set_enabled", "Autotuner", "controller",
+           "register_loader", "register_trainer", "feed_straggler_table",
+           "decisions", "reset", "selftest", "main"]
+
+_enabled_override = None
+
+# the decision windows accumulate TRAIN-step records only: gluon.Trainer
+# and Module journal under these origins.  Serving-batch and ad-hoc
+# windows carry the wrong signals (no data_wait, foreign wall)
+_TRAIN_ORIGINS = frozenset(("trainer", "module"))
+
+
+def set_enabled(flag):
+    """Force the autotuner on/off (None = defer to GRAFT_AUTOTUNE)."""
+    global _enabled_override
+    _enabled_override = flag
+
+
+def enabled():
+    if _enabled_override is not None:
+        return bool(_enabled_override)
+    return os.environ.get("GRAFT_AUTOTUNE", "").strip().lower() \
+        in ("1", "true", "yes", "on")
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+class Autotuner(object):
+    """The guarded controller.  One instance is the process-wide
+    singleton (:func:`controller`); tests construct their own with
+    explicit knobs and install it via :func:`_install`."""
+
+    def __init__(self, interval=None, cooldown=None, data_wait_bound=None,
+                 comm_hidden_bound=None, max_workers=None,
+                 min_bucket_bytes=None, max_bucket_bytes=None):
+        self.interval = interval if interval is not None \
+            else _env_int("GRAFT_AUTOTUNE_INTERVAL", 8)
+        self.cooldown = cooldown if cooldown is not None \
+            else _env_int("GRAFT_AUTOTUNE_COOLDOWN", 2)
+        self.data_wait_bound = data_wait_bound if data_wait_bound is not None \
+            else _env_float("GRAFT_AUTOTUNE_DATA_WAIT", 0.15)
+        self.comm_hidden_bound = comm_hidden_bound \
+            if comm_hidden_bound is not None \
+            else _env_float("GRAFT_AUTOTUNE_COMM_HIDDEN", 0.5)
+        self.max_workers = max_workers if max_workers is not None \
+            else _env_int("GRAFT_AUTOTUNE_MAX_WORKERS", 8)
+        self.min_bucket_bytes = min_bucket_bytes \
+            if min_bucket_bytes is not None \
+            else _env_int("GRAFT_AUTOTUNE_MIN_BUCKET_BYTES", 256 << 10)
+        self.max_bucket_bytes = max_bucket_bytes \
+            if max_bucket_bytes is not None \
+            else _env_int("GRAFT_AUTOTUNE_MAX_BUCKET_BYTES", 64 << 20)
+        self._lock = threading.Lock()
+        self._loaders = []          # weakrefs, registration order
+        self._trainers = []         # weakrefs
+        self._window = []           # lens records of the open window
+        self._cooldowns = {}        # knob -> windows remaining
+        self._hidden_at_move = None  # hidden ratio WHEN the last bucket
+        #                              move was made (climb evaluation)
+        self._bucket_move_pending = False   # that move awaits one eval
+        self._bucket_dir = -1       # -1 shrink first, +1 grow
+        self._decisions = []
+
+    # -- registration --------------------------------------------------------
+    def attach_loader(self, loader):
+        with self._lock:
+            self._loaders = [r for r in self._loaders if r() is not None]
+            if not any(r() is loader for r in self._loaders):
+                self._loaders.append(weakref.ref(loader))
+
+    def attach_trainer(self, trainer):
+        with self._lock:
+            self._trainers = [r for r in self._trainers if r() is not None]
+            if not any(r() is trainer for r in self._trainers):
+                self._trainers.append(weakref.ref(trainer))
+
+    def _live(self, refs):
+        return [r() for r in refs if r() is not None]
+
+    # -- the lens observer ---------------------------------------------------
+    def on_step(self, rec):
+        """One finalized lens record.  GRAFT_AUTOTUNE off = immediate
+        return: the default path stays bit-identical."""
+        if not enabled():
+            return
+        if rec.get("origin") not in _TRAIN_ORIGINS:
+            # the lens streams EVERY window — serving batches
+            # (origin "serve_batch"), ad-hoc step_end callers — and a
+            # train+serve process would fill decision windows with
+            # serving records (data_wait 0, nonzero wall), diluting
+            # data_frac below the bound while the DataLoader starves.
+            # Decide on train-step windows only
+            return
+        with self._lock:
+            self._window.append(rec)
+            if len(self._window) < self.interval:
+                return
+            window, self._window = self._window, []
+            self._evaluate_locked(window)
+
+    # -- decision logic ------------------------------------------------------
+    def _evaluate_locked(self, window):
+        wall = sum(r["wall_s"] for r in window)
+        if wall <= 0:
+            return
+        for knob in list(self._cooldowns):
+            self._cooldowns[knob] -= 1
+            if self._cooldowns[knob] <= 0:
+                del self._cooldowns[knob]
+        data_frac = sum(r["components"]["data_wait"] for r in window) / wall
+        _metrics.autotune_signal("data_wait_fraction", data_frac)
+        inflight = sum(r["comm_inflight_s"] for r in window)
+        blocked = sum(r["comm_blocked_s"] for r in window)
+        hidden = None
+        if inflight > 0:
+            hidden = max(0.0, min(1.0, 1.0 - blocked / inflight))
+            _metrics.autotune_signal("comm_hidden_ratio", hidden)
+        if data_frac > self.data_wait_bound:
+            self._grow_workers(data_frac)
+        if hidden is not None:
+            self._tune_bucket_bytes(hidden)
+
+    def _grow_workers(self, data_frac):
+        if "dataloader_workers" in self._cooldowns:
+            return
+        # rank by the blocked-wait DELTA since this loader was last
+        # considered: the window's data_wait belongs to the loader the
+        # consumer actually stalled on — growing in registration order
+        # would walk a fast first-registered loader to the cap while the
+        # starved one waits.  Ties (no per-loader signal, e.g. synthetic
+        # windows) keep registration order — sort is stable
+        ranked = []
+        for loader in self._live(self._loaders):
+            total = float(getattr(loader, "_blocked_wait_s", 0.0))
+            seen = float(getattr(loader, "_graft_autotune_wait_seen", 0.0))
+            loader._graft_autotune_wait_seen = total
+            ranked.append((total - seen, loader))
+        ranked.sort(key=lambda pair: -pair[0])
+        for _delta, loader in ranked:
+            old = int(getattr(loader, "_num_workers", 0))
+            new = min(self.max_workers, max(1, old * 2))
+            if new <= old:
+                continue        # this loader is at the cap — try the next
+            try:
+                loader.set_num_workers(new)
+            except Exception:
+                continue
+            self._decide("data_wait", "dataloader_workers", old, new,
+                         data_wait_fraction=round(data_frac, 4))
+            return
+
+    def _tune_bucket_bytes(self, hidden):
+        if "bucket_bytes" in self._cooldowns:
+            return              # the last move's effect is still landing
+        # hill-climb: a move that made the ratio WORSE flips direction.
+        # The last BUCKET move is tracked explicitly (not via the global
+        # decision log — an interleaved worker-growth decision would
+        # mask it and let the climb keep walking the wrong way), and it
+        # is settled at the FIRST post-cooldown window no matter where
+        # the ratio sits: a move that RECOVERED the ratio above the
+        # bound must clear here too, or the stale _hidden_at_move would
+        # be judged against an unrelated sag many windows later and
+        # flip the climb away from a setting it just validated
+        if self._bucket_move_pending:
+            self._bucket_move_pending = False
+            if hidden < self._hidden_at_move:
+                self._bucket_dir = -self._bucket_dir
+        if hidden >= self.comm_hidden_bound \
+                or not self._live(self._trainers):
+            return
+        try:
+            import jax
+            multi_rank = jax.process_count() > 1
+        except Exception:
+            multi_rank = False
+        if multi_rank:
+            # per-rank hill-climb moves diverge the collective stream:
+            # one rank shrinking while a peer holds re-packs DIFFERENT
+            # bucket plans, the mispaired wire hangs, and the lockstep
+            # auditor fires on a healthy job.  Bucket moves must stay
+            # rank-consistent (ROADMAP); until a move can ride a
+            # collective agreement step this knob is single-process only
+            return
+        from ..overlap import DEFAULT_BUCKET_BYTES
+        try:
+            cur = int(os.environ.get("GRAFT_BUCKET_BYTES",
+                                     str(DEFAULT_BUCKET_BYTES)))
+        except ValueError:
+            cur = DEFAULT_BUCKET_BYTES
+        if cur <= 0:
+            return              # bucketing disabled: not ours to enable
+        new = cur // 2 if self._bucket_dir < 0 else cur * 2
+        new = max(self.min_bucket_bytes, min(self.max_bucket_bytes, new))
+        if new == cur:
+            self._bucket_dir = -self._bucket_dir    # at a bound: reflect
+            new = cur // 2 if self._bucket_dir < 0 else cur * 2
+            new = max(self.min_bucket_bytes,
+                      min(self.max_bucket_bytes, new))
+            if new == cur:
+                return
+        os.environ["GRAFT_BUCKET_BYTES"] = str(new)
+        self._hidden_at_move = hidden
+        self._bucket_move_pending = True
+        self._decide("comm_hidden", "bucket_bytes", cur, new,
+                     comm_hidden_ratio=round(hidden, 4))
+
+    def feed_straggler_table(self, rows):
+        """Feed cross-rank straggler lateness (``aggregate.py`` rows, or
+        any ``{"label": bucket label, "lateness_s": seconds}`` list)
+        into the registered Trainers' bucket-order tie-breaker, then
+        drop their plan caches so the next plan re-packs systematically
+        late buckets earlier.  Returns the number of buckets matched."""
+        lateness = {}
+        for row in rows:
+            label = row.get("label")
+            late = row.get("lateness_s", row.get("enter_spread_s"))
+            if label is None or late is None:
+                continue
+            lateness[label] = max(lateness.get(label, 0.0), float(late))
+        if not lateness:
+            return 0
+        matched = 0
+        with self._lock:
+            trainers = self._live(self._trainers)
+        for t in trainers:
+            hit = False
+            for cache_attr in ("_fused_plan_cache", "_duplex_plan_cache"):
+                cached = getattr(t, cache_attr, None)
+                if cached is None or cached[1] is None:
+                    continue
+                for b in cached[1][0]:
+                    late = lateness.get(t._sched_label(b))
+                    if late is not None:
+                        t._note_bucket_lateness(b, late)
+                        matched += 1
+                        hit = True
+            if hit:
+                # force a re-pack with the fresh tie-break (one tuple-
+                # compare miss next step; the serial fallback step is
+                # the documented plan-change cost)
+                t._fused_plan_cache = None
+                t._duplex_plan_cache = None
+        if matched:
+            self._decide("straggler_lateness", "bucket_order",
+                         "cached-plan", "re-pack",
+                         buckets_matched=matched,
+                         labels=sorted(lateness))
+        return matched
+
+    def _decide(self, signal, target, old, new, **extra):
+        rec = dict(signal=signal, target=target, old=old, new=new,
+                   cooldown_windows=self.cooldown, **extra)
+        self._decisions.append(rec)
+        self._cooldowns[target] = self.cooldown
+        _blackbox.record("autotune_decision", **rec)
+        _metrics.autotune_decision(signal, target, old,
+                                   new if isinstance(new, (int, float))
+                                   else 1.0)
+
+    def decisions(self):
+        return [dict(d) for d in self._decisions]
+
+
+# ---------------------------------------------------------------------------
+# the process-wide singleton + registration surface
+# ---------------------------------------------------------------------------
+
+_controller = [None]
+_controller_lock = threading.Lock()
+
+
+def controller():
+    """The process-wide controller (created on first registration and
+    hooked into the lens observer stream)."""
+    with _controller_lock:
+        if _controller[0] is None:
+            _install(Autotuner())
+        return _controller[0]
+
+
+def _install(ctrl):
+    """Swap the active controller (tests / selftest).  Call under no
+    lock of ``ctrl``."""
+    old = _controller[0]
+    if old is not None:
+        _lens.remove_observer(old.on_step)
+    _controller[0] = ctrl
+    if ctrl is not None:
+        _lens.add_observer(ctrl.on_step)
+    return old
+
+
+def register_loader(loader):
+    """Called by ``DataLoader.__init__``: the loader becomes a worker-
+    growth target.  Weak registration — no lifetime change, ~free when
+    the autotuner is off."""
+    controller().attach_loader(loader)
+
+
+def register_trainer(trainer):
+    """Called by ``gluon.Trainer.__init__``: the trainer becomes a
+    bucket-bytes / bucket-order target."""
+    controller().attach_trainer(trainer)
+
+
+def feed_straggler_table(rows):
+    """Module-level convenience over :meth:`Autotuner.feed_straggler_table`
+    (e.g. piping ``telemetry --analyze --json``'s ``stragglers`` rows
+    back into a live job)."""
+    return controller().feed_straggler_table(rows)
+
+
+def decisions():
+    c = _controller[0]
+    return c.decisions() if c is not None else []
+
+
+def reset():
+    """Drop the controller (tests)."""
+    with _controller_lock:
+        _install(None)
+
+
+# ---------------------------------------------------------------------------
+# selftest: the synthetic starved-DataLoader scenario (lint tier)
+# ---------------------------------------------------------------------------
+
+def selftest(max_steps=80, item_delay_s=0.005, compute_s=0.004,
+             verbose=False):
+    """The controller must grow the loader's workers until the data_wait
+    fraction drops below the bound, within ``max_steps``.  Returns a
+    list of problems — empty means pass."""
+    import time as _time
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon
+    from incubator_mxnet_tpu.gluon.data import DataLoader
+    from incubator_mxnet_tpu.gluon.data.dataset import Dataset
+
+    class SlowDataset(Dataset):
+        def __init__(self, n):
+            self._n = n
+
+        def __len__(self):
+            return self._n
+
+        def __getitem__(self, idx):
+            _time.sleep(item_delay_s)       # the starved producer
+            return np.full((4,), float(idx), np.float32)
+
+    problems = []
+    prev_lens = _lens._enabled_override
+    _lens.set_enabled(True)
+    _lens.reset()
+    set_enabled(True)
+    ctrl = Autotuner(interval=4, cooldown=1, data_wait_bound=0.10,
+                     max_workers=4)
+    old_ctrl = _install(ctrl)
+    try:
+        p = gluon.Parameter("at0", shape=(4,))
+        p.initialize(ctx=mx.cpu())
+        trainer = gluon.Trainer([p], "sgd", {"learning_rate": 0.01},
+                                kvstore=mx.kv.create("local"))
+        loader = DataLoader(SlowDataset(4096), batch_size=4,
+                            num_workers=1, prefetch_device=False)
+        ctrl.attach_loader(loader)
+
+        steps = 0
+        window_fracs = []
+        it = iter(loader)
+        while steps < max_steps:
+            batch = next(it)
+            with autograd.record():
+                loss = (p.data() * batch.mean()).sum()
+            loss.backward()
+            _time.sleep(compute_s)          # the synthetic device step
+            trainer.step(1)
+            steps += 1
+            recs = _lens.steps()
+            if recs and steps % ctrl.interval == 0:
+                w = recs[-ctrl.interval:]
+                wall = sum(r["wall_s"] for r in w)
+                frac = sum(r["components"]["data_wait"] for r in w) / wall
+                window_fracs.append(frac)
+                if verbose:
+                    print("step %d workers=%d data_wait=%.2f"
+                          % (steps, loader._num_workers, frac))
+                grew = any(d["target"] == "dataloader_workers"
+                           for d in ctrl.decisions())
+                if grew and frac < ctrl.data_wait_bound:
+                    break
+        grows = [d for d in ctrl.decisions()
+                 if d["target"] == "dataloader_workers"]
+        if not grows:
+            problems.append("controller never grew the starved loader's "
+                            "workers (final data_wait windows: %s)"
+                            % [round(f, 3) for f in window_fracs[-4:]])
+        if not window_fracs or window_fracs[-1] >= ctrl.data_wait_bound:
+            problems.append(
+                "data_wait fraction never converged below the %.2f bound "
+                "within %d steps (windows: %s, workers: %d)"
+                % (ctrl.data_wait_bound, steps,
+                   [round(f, 3) for f in window_fracs[-6:]],
+                   loader._num_workers))
+        ring = [e for e in _blackbox.events()
+                if e.get("kind") == "autotune_decision"]
+        if len(ring) < len(ctrl.decisions()):
+            problems.append("only %d of %d decisions landed in the "
+                            "flight-recorder ring"
+                            % (len(ring), len(ctrl.decisions())))
+        loader.close()
+        return problems
+    finally:
+        _install(old_ctrl)
+        set_enabled(None)
+        _lens.set_enabled(prev_lens)
+        _lens.reset()
+
+
+def main(argv=None):
+    import argparse
+    import sys
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser(
+        prog="python -m incubator_mxnet_tpu.telemetry.autotune",
+        description="graftpulse autotuner selftest")
+    ap.add_argument("--selftest", action="store_true",
+                    help="synthetic starved-DataLoader scenario: the "
+                         "controller must converge (CI smoke tier)")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    if not args.selftest:
+        ap.print_help()
+        return 2
+    problems = selftest(verbose=args.verbose)
+    if problems:
+        for p in problems:
+            print("graftpulse autotune selftest FAIL: %s" % p,
+                  file=sys.stderr)
+        return 1
+    print("graftpulse autotune selftest OK (starved loader converged; "
+          "decisions journaled)")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

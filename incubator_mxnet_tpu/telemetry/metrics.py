@@ -671,6 +671,48 @@ def lens_step(rec):
             dev["busy_s"] / wall if wall > 0 else 0.0)
 
 
+# -- graftpulse: memory timeline + autotuner ---------------------------------
+
+
+def mem_sample(site, in_use, peak):
+    """One device-memory watermark sample at an attribution site
+    (telemetry/lens.py ``mem_sample``: engine flush boundaries, fused/
+    duplex buckets, serving batches)."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    r.gauge("graft_mem_peak_bytes",
+            "Live device-bytes watermark by attribution site (window-"
+            "local; the allocator's lifetime peak would tie every site)",
+            ("site",)).set(peak, site=site)
+    r.gauge("graft_mem_bytes_in_use",
+            "Device bytes in use at the last memory-timeline sample"
+            ).set(in_use)
+
+
+def autotune_decision(signal, target, old, new):
+    """One autotuner control decision (telemetry/autotune.py) — the
+    controller is itself observable: every decision counts here and
+    journals as a blackbox ``autotune_decision`` event."""
+    if not enabled():
+        return
+    _REGISTRY.counter("graft_autotune_decisions_total",
+                      "Autotuner control decisions by signal",
+                      ("signal",)).inc(signal=signal)
+    _REGISTRY.gauge("graft_autotune_setting",
+                    "Current value of each autotuned knob",
+                    ("target",)).set(float(new), target=target)
+
+
+def autotune_signal(name, value):
+    """The controller's view of its input signals (window means)."""
+    if not enabled():
+        return
+    _REGISTRY.gauge("graft_autotune_signal",
+                    "Autotuner input signal (window mean)",
+                    ("signal",)).set(float(value), signal=name)
+
+
 # -- graftwatch: watchdog + dist liveness ------------------------------------
 
 _SKEW_BUCKETS = (1e-4, 1e-3, 1e-2, 0.1, 0.5, 1.0, 5.0, 30.0)

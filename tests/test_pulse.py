@@ -1,0 +1,877 @@
+"""graftpulse tests (ISSUE 12): the ASYNC device-time ledger (exact-sum
+conservation on plain deferred train loops, no-double-booking with sync
+mode, watermark span-union), the per-site memory timeline, the
+profiler-trace ingestion fallback, the lens-driven autotuner (worker
+growth, bucket-bytes hill-climb, straggler feed, decision journaling,
+off-by-default bit-identity), and the lockstep online bisection
+satellite (a mid-stream skipped collective is pinned exactly)."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, engine, gluon, profiler
+from incubator_mxnet_tpu.telemetry import aggregate, autotune, blackbox, lens
+
+
+@pytest.fixture
+def fresh_lens():
+    """A clean, force-enabled lens (+ pulse) for one test."""
+    lens.set_enabled(True)
+    lens.reset()
+    lens.reset_pulse_stats()
+    yield lens
+    lens.pulse_drain(5.0)
+    lens.reset()
+    lens.set_pulse(None)
+    lens.set_mem_sampler(None)
+    lens.set_enabled(None)
+
+
+def _build_params(n, shape=(8, 8), prefix="pp", seed=0):
+    rs = np.random.RandomState(seed)
+    ps = []
+    for k in range(n):
+        p = gluon.Parameter("%s%d" % (prefix, k), shape=shape)
+        p.initialize(ctx=mx.cpu())
+        p.data()._write(rs.randn(*shape).astype(np.float32))
+        ps.append(p)
+    return ps
+
+
+def _train_step(ps, trainer, bulk=True):
+    if bulk:
+        with engine.bulk(64):
+            with autograd.record():
+                loss = None
+                for p in ps:
+                    y = (p.data() * p.data()).sum()
+                    loss = y if loss is None else loss + y
+            loss.backward()
+    else:
+        with autograd.record():
+            loss = None
+            for p in ps:
+                y = (p.data() * p.data()).sum()
+                loss = y if loss is None else loss + y
+        loss.backward()
+    trainer.step(1)
+
+
+def _assert_device_conserved(rec):
+    d = rec.get("device")
+    assert d is not None, "device ledger empty on an async step: %r" % rec
+    # the exact-sum contract: busy + idle == wall, bit-exact (idle is
+    # wall - busy by construction, busy clamped at wall)
+    assert d["busy_s"] + d["idle_s"] == rec["wall_s"]
+    assert 0.0 < d["busy_s"] <= rec["wall_s"]
+    assert d["idle_s"] >= 0.0
+    assert d["spans"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# the async device ledger (tentpole)
+# ---------------------------------------------------------------------------
+
+def test_async_ledger_conservation_on_deferred_loop(fresh_lens):
+    """ISSUE 12 acceptance: on a PLAIN deferred (bulked, async — no
+    sync mode, no profiler) train loop, every step window's device
+    ledger satisfies busy + idle == wall exactly, fed only by the pulse
+    reaper's done-callbacks."""
+    assert not profiler.want_sync()
+    ps = _build_params(4)
+    trainer = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01},
+                            kvstore=mx.kv.create("local"))
+    for _ in range(4):
+        _train_step(ps, trainer, bulk=True)
+        # settle this window's callbacks before the NEXT step closes it
+        # (a span completing after step_end books into the next window
+        # by design; the drain pins the test deterministic)
+        assert lens.pulse_drain(10.0)
+    ps[-1].data().asnumpy()
+    recs = lens.steps()
+    assert len(recs) == 4
+    # the first window opens at first activity; later windows are the
+    # steady-state contract surface
+    for rec in recs[1:]:
+        _assert_device_conserved(rec)
+    stats = lens.pulse_stats()
+    assert stats["enqueued"] > 0
+    assert stats["booked"] > 0
+    assert stats["pending"] == 0
+
+
+def test_async_ledger_fills_on_unbulked_eager_loop(fresh_lens):
+    """Per-op done-callbacks: an eager (never-bulked) loop's dispatches
+    feed the same ledger."""
+    ps = _build_params(3)
+    trainer = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01},
+                            kvstore=mx.kv.create("local"))
+    for _ in range(3):
+        _train_step(ps, trainer, bulk=False)
+        assert lens.pulse_drain(10.0)
+    recs = lens.steps()
+    for rec in recs[1:]:
+        _assert_device_conserved(rec)
+
+
+def test_no_double_booking_when_sync_and_callbacks_both_active(
+        fresh_lens, tmp_path):
+    """ISSUE 12 satellite: with profiler sync mode on AND the pulse
+    ledger on, flushes/ops book directly (sync) and must NOT also
+    enqueue to the reaper — the enqueue counter stays at zero, and the
+    ledger still conserves."""
+    lens.set_pulse(True)
+    lens.reset_pulse_stats()
+    ps = _build_params(3, prefix="sy")
+    trainer = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01},
+                            kvstore=mx.kv.create("local"))
+    _train_step(ps, trainer, bulk=True)      # warm plans/compiles async
+    lens.pulse_drain(10.0)
+    lens.reset()
+    lens.reset_pulse_stats()
+    profiler.set_config(filename=str(tmp_path / "p.json"),
+                        profile_all=True, sync=True)
+    profiler.set_state("run")
+    try:
+        for _ in range(3):
+            _train_step(ps, trainer, bulk=True)
+    finally:
+        profiler.set_state("stop")
+    recs = lens.steps()
+    assert any(r.get("device") for r in recs)
+    for rec in recs:
+        d = rec.get("device")
+        if d is not None:
+            assert d["busy_s"] + d["idle_s"] == rec["wall_s"]
+    # every dispatch inside the loop ran under sync mode: direct
+    # booking only, zero reaper enqueues (the no-double-booking gate)
+    assert lens.pulse_stats()["enqueued"] == 0
+
+
+def test_device_watermark_merges_overlapping_spans(fresh_lens):
+    """The union watermark: re-booking the same span (or an overlapping
+    one) adds only the uncovered part — the double-delivery rail."""
+    t0 = time.perf_counter()
+    st = lens._state()
+    lens.device(t0, t0 + 1.0)
+    lens.device(t0, t0 + 1.0)            # exact duplicate: no-op
+    lens.device(t0 + 0.5, t0 + 1.5)      # overlap: only +0.5 books
+    assert st.device_s == pytest.approx(1.5)
+    lens._tls.lens = None
+
+
+def test_pulse_kill_switch_restores_empty_ledger(fresh_lens):
+    """GRAFT_PULSE=0 (via set_pulse): async loops book nothing — the
+    pre-PR-12 behavior."""
+    lens.set_pulse(False)
+    lens.reset_pulse_stats()
+    ps = _build_params(3, prefix="ko")
+    trainer = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01},
+                            kvstore=mx.kv.create("local"))
+    for _ in range(3):
+        _train_step(ps, trainer, bulk=True)
+    recs = lens.steps()
+    assert all("device" not in r for r in recs)
+    assert lens.pulse_stats()["enqueued"] == 0
+
+
+def test_reaper_releases_result_refs_after_drain(fresh_lens):
+    """The reaper must not pin result buffers past the drain: locals
+    surviving into its idle wait would hold dead arrays for the poll
+    interval and make live-arrays memory accounting flicker
+    (regression: profiler.device_memory interference)."""
+    import gc
+    import jax
+
+    def live_big():
+        gc.collect()
+        return sum(x.nbytes for x in jax.live_arrays()
+                   if x.nbytes >= 256 * 256 * 4)
+
+    lens.pulse_drain(10.0)
+    base = live_big()
+    a = mx.nd.ones((256, 256))
+    for _ in range(4):
+        b = (a * 2.0) + 1.0
+        b.asnumpy()
+    assert lens.pulse_drain(10.0)
+    del b
+    grew = live_big() - base
+    assert grew == 256 * 256 * 4, \
+        "reaper pinned dead result buffers: %+d bytes vs `a` alone" % grew
+
+
+class _Boom(object):                        # kills the live reaper: its
+    def wait(self, t):                      # next idle wake raises and
+        raise SystemExit                    # the thread exits silently
+
+    def clear(self):
+        pass
+
+    def set(self):
+        pass
+
+    def is_set(self):
+        return True                         # suppress device_async wakes
+
+
+def _kill_reaper():
+    """Settle, then make the live reaper thread exit — the 'fork's
+    child' scenario (dead inherited thread) without a real fork."""
+    assert lens.pulse_drain(10.0)           # settle to a known-idle state
+    dead = lens._pulse_thread[0]
+    real_wake = lens._pulse_wake
+    lens._pulse_wake = _Boom()
+    try:
+        dead.join(5.0)
+        assert not dead.is_alive(), "reaper refused to die — test broken"
+    finally:
+        lens._pulse_wake = real_wake
+    return dead
+
+
+def test_pulse_drain_revives_dead_reaper_with_latched_busy(fresh_lens):
+    """A fork mid-batch leaves the child an empty queue, a DEAD reaper
+    thread, and _pulse_busy latched True: pulse_drain must still start
+    a fresh reaper (whose first empty pop clears the flag) instead of
+    burning its whole timeout on a flag nobody will ever reset."""
+    dead = _kill_reaper()
+    lens._pulse_busy[0] = True              # "it died mid-batch"
+    t0 = time.perf_counter()
+    assert lens.pulse_drain(5.0), \
+        "drain burned its timeout on the latched busy flag"
+    assert time.perf_counter() - t0 < 2.0
+    assert lens._pulse_busy[0] is False
+    assert lens._pulse_thread[0] is not dead    # a fresh reaper took over
+
+
+def test_ensure_reaper_spawns_exactly_one_under_concurrency(fresh_lens):
+    """Two threads' FIRST concurrent enqueues both see no live reaper:
+    the spawn must serialize to ONE thread — two loops fighting over
+    _pulse_busy let pulse_drain return while spans are still unbooked."""
+    import threading
+    _kill_reaper()
+    start = threading.Barrier(8)
+
+    def hit():
+        start.wait()
+        lens._ensure_reaper()
+
+    ts = [threading.Thread(target=hit) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(5.0)
+    alive = [t for t in threading.enumerate()
+             if t.name == "graft-pulse-reaper" and t.is_alive()]
+    assert len(alive) == 1, "%d reaper loops running" % len(alive)
+
+
+# ---------------------------------------------------------------------------
+# the memory timeline (tentpole)
+# ---------------------------------------------------------------------------
+
+def test_memory_timeline_sites_and_step_field(fresh_lens):
+    """Injected sampler (host CPU reports no allocator counters): flush
+    boundaries and fused buckets sample per-site watermarks; the step
+    record carries the window's peak + per-site peaks; the gauges
+    publish."""
+    counter = [0]
+
+    def sampler():
+        counter[0] += 1000
+        return counter[0], counter[0] + 10
+
+    lens.set_mem_sampler(sampler)
+    ps = _build_params(4, prefix="mm")
+    trainer = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01},
+                            kvstore=mx.kv.create("local"))
+    for _ in range(3):
+        _train_step(ps, trainer, bulk=True)
+    recs = lens.steps()
+    steady = recs[-1]
+    mem = steady.get("mem")
+    assert mem is not None
+    sites = mem["sites"]
+    assert any(s.startswith("flush:") for s in sites)
+    assert any(s.startswith("bucket[") for s in sites)
+    assert mem["peak_bytes"] == max(sites.values())
+    # the timeline ring + summary aggregate the same stream
+    summ = lens.mem_summary()
+    assert set(sites) <= set(summ)
+    for s in summ.values():
+        assert s["samples"] >= 1 and s["peak_bytes"] > 0
+    # gauges: one series per site
+    from incubator_mxnet_tpu import telemetry
+    snap = telemetry.registry().snapshot()
+    fam = snap.get("graft_mem_peak_bytes")
+    assert fam is not None
+    gauge_sites = {t["labels"]["site"] for t in fam["samples"]}
+    assert set(sites) <= gauge_sites
+
+
+def test_memory_sampler_auto_disables_without_allocator(fresh_lens):
+    """On backends with no allocator counters (host CPU) the default
+    sampler latches off after ONE probe — per-flush cost stays nil."""
+    lens.reset_mem()
+    assert lens.mem_sample("probe") is None
+    assert lens._mem_auto_dead[0] is True
+    # an explicit sampler re-arms
+    lens.set_mem_sampler(lambda: (1, 2))
+    assert lens.mem_sample("probe2") == (1, 2)
+
+
+def test_mem_compact_embeds_peak(fresh_lens):
+    lens.set_mem_sampler(lambda: (5, 7))
+    lens.mem_sample("x")
+    rec = lens.step_end("test")
+    # peak_bytes is the LIVE-bytes watermark (site attribution basis);
+    # the raw allocator peak rides along separately
+    assert rec["mem"]["peak_bytes"] == 5
+    assert rec["mem"]["alloc_peak_bytes"] == 7
+    assert lens.compact(rec)["mem_peak_bytes"] == 5
+
+
+def test_mem_sites_differentiate_under_lifetime_allocator_peak(fresh_lens):
+    """Real allocators report a process-lifetime peak that never resets:
+    once the global peak is hit, keying sites off it would tie every
+    site to one constant.  Attribution must track LIVE bytes per site —
+    the planner's 'which bucket drives the footprint' signal."""
+    samples = [(8000, 8000),    # the early global peak, site a
+               (1000, 8000),    # site b: low live bytes, stale peak
+               (3000, 8000)]    # site c
+    it = iter(samples)
+    lens.set_mem_sampler(lambda: next(it))
+    lens.mem_sample("a")
+    lens.mem_sample("b")
+    lens.mem_sample("c")
+    rec = lens.step_end("test")
+    assert rec["mem"]["sites"] == {"a": 8000, "b": 1000, "c": 3000}
+    assert rec["mem"]["peak_bytes"] == 8000
+    assert rec["mem"]["alloc_peak_bytes"] == 8000
+    summ = lens.mem_summary()
+    assert summ["b"]["peak_bytes"] == 1000
+    assert summ["b"]["alloc_peak_bytes"] == 8000
+
+
+# ---------------------------------------------------------------------------
+# profiler-trace ingestion (the callback-less fallback)
+# ---------------------------------------------------------------------------
+
+def test_ingest_xla_unions_overlapping_device_spans(tmp_path):
+    """Synthetic chrome trace: overlapping device spans must UNION per
+    step (never sum), busy + idle == wall per row, unstamped device
+    spans pool separately, host spans are ignored."""
+    us = 1e6
+    events = [
+        {"ph": "M", "name": "process_name", "pid": "d0",
+         "args": {"name": "TPU:0 device stream"}},
+        # step 1: two overlapping spans 0-10ms and 5-15ms -> 15ms busy
+        {"ph": "X", "name": "op", "pid": "d0", "tid": 1,
+         "ts": 0.000 * us, "dur": 0.010 * us, "args": {"step": 1}},
+        {"ph": "X", "name": "op", "pid": "d0", "tid": 1,
+         "ts": 0.005 * us, "dur": 0.010 * us, "args": {"step": 1}},
+        # step 2: one span 20-25ms; window = prev end (15ms) -> 25ms
+        {"ph": "X", "name": "op", "pid": "d0", "tid": 1,
+         "ts": 0.020 * us, "dur": 0.005 * us, "args": {"step": 2}},
+        # our own sync-mode flush span (host pid, device_time arg)
+        {"ph": "X", "name": "bulk_segment_flush", "pid": 77, "tid": 2,
+         "ts": 0.030 * us, "dur": 0.002 * us,
+         "args": {"device_time": True}},
+        # host span: ignored
+        {"ph": "X", "name": "host", "pid": 77, "tid": 2,
+         "ts": 0.000 * us, "dur": 0.050 * us, "args": {}},
+    ]
+    path = tmp_path / "trace.json"
+    path.write_text(json.dumps({"traceEvents": events}))
+    report = aggregate.ingest_xla(str(path))
+    assert report["problems"] == []
+    assert report["device_events"] == 4
+    rows = {r["step"]: r for r in report["steps"]}
+    assert rows[1]["busy_s"] == pytest.approx(0.015)
+    assert rows[1]["wall_s"] == pytest.approx(0.015)
+    assert rows[2]["busy_s"] == pytest.approx(0.005)
+    assert rows[2]["wall_s"] == pytest.approx(0.010)   # 15ms -> 25ms
+    for r in report["steps"]:
+        assert r["busy_s"] + r["idle_s"] == pytest.approx(r["wall_s"])
+    assert rows[None]["spans"] == 1                    # the flush span
+
+
+def test_ingest_xla_total_is_span_union_not_row_sum(tmp_path):
+    """Unstamped spans pool into a None row whose window OVERLAPS the
+    stamped rows' chained windows: the total must be the union over all
+    device spans, not the sum of row walls (which would double the wall
+    and halve the headline busy_fraction)."""
+    us = 1e6
+    path = tmp_path / "u.json"
+    path.write_text(json.dumps({"traceEvents": [
+        {"ph": "X", "name": "op", "pid": "/device:TPU:0", "tid": 1,
+         "ts": 0.000 * us, "dur": 0.010 * us, "args": {"step": 1}},
+        {"ph": "X", "name": "op", "pid": "/device:TPU:0", "tid": 1,
+         "ts": 0.020 * us, "dur": 0.005 * us, "args": {"step": 2}},
+        # unstamped span covering the WHOLE capture
+        {"ph": "X", "name": "op", "pid": "/device:TPU:0", "tid": 1,
+         "ts": 0.000 * us, "dur": 0.030 * us, "args": {}}]}))
+    report = aggregate.ingest_xla(str(path))
+    assert report["total"]["wall_s"] == pytest.approx(0.030)
+    assert report["total"]["busy_s"] == pytest.approx(0.030)
+    assert report["total"]["busy_fraction"] == pytest.approx(1.0)
+
+
+def test_ingest_xla_flags_non_monotonic_step_ids(tmp_path):
+    """A restarted step counter (or merged captures) puts a low step id
+    LATE in time: id-order window chaining clamps its successors' wall
+    to 0 — the report must say so in problems[], not zero silently."""
+    us = 1e6
+    path = tmp_path / "nm.json"
+    path.write_text(json.dumps({"traceEvents": [
+        # step 5 runs first in time, step 1 (restarted counter) after —
+        # id order chains step 5's window start past its own spans
+        {"ph": "X", "name": "op", "pid": "/device:TPU:0", "tid": 1,
+         "ts": 0.000 * us, "dur": 0.010 * us, "args": {"step": 5}},
+        {"ph": "X", "name": "op", "pid": "/device:TPU:0", "tid": 1,
+         "ts": 0.100 * us, "dur": 0.010 * us, "args": {"step": 1}}]}))
+    report = aggregate.ingest_xla(str(path))
+    rows = {r["step"]: r for r in report["steps"]}
+    assert rows[5]["wall_s"] == 0.0                 # the clamped row
+    assert any("not time-monotonic" in p for p in report["problems"])
+
+
+def test_ingest_xla_cli(tmp_path, capsys):
+    from incubator_mxnet_tpu.telemetry.__main__ import main as tmain
+    us = 1e6
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps({"traceEvents": [
+        {"ph": "X", "name": "op", "pid": "/device:TPU:0", "tid": 1,
+         "ts": 0, "dur": 0.004 * us, "args": {"step": 1}}]}))
+    rc = tmain(["--ingest-xla", str(path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "device-ledger ingestion" in out
+    assert "1" in out
+    # external traces stamp steps as strings: "2" must pool with 2 and
+    # a non-numeric stamp must sort, not TypeError against ints
+    path3 = tmp_path / "m.json"
+    path3.write_text(json.dumps({"traceEvents": [
+        {"ph": "X", "name": "op", "pid": "/device:TPU:0", "tid": 1,
+         "ts": 0, "dur": 1000, "args": {"step": 2}},
+        {"ph": "X", "name": "op", "pid": "/device:TPU:0", "tid": 1,
+         "ts": 2000, "dur": 1000, "args": {"step": "2"}},
+        {"ph": "X", "name": "op", "pid": "/device:TPU:0", "tid": 1,
+         "ts": 4000, "dur": 1000, "args": {"step": "warmup"}}]}))
+    report = aggregate.ingest_xla(str(path3))
+    assert [r["step"] for r in report["steps"]] == [2, "warmup"]
+    assert report["steps"][0]["spans"] == 2
+    # empty trace: rc 1 + a problem line
+    path2 = tmp_path / "e.json"
+    path2.write_text(json.dumps({"traceEvents": []}))
+    assert tmain(["--ingest-xla", str(path2)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# the autotuner (tentpole)
+# ---------------------------------------------------------------------------
+
+def _fake_rec(step, wall=0.1, data_wait=0.0, blocked=0.0, inflight=0.0):
+    comp = {c: 0.0 for c in lens.COMPONENTS}
+    comp["data_wait"] = data_wait
+    comp["host_gap"] = wall - data_wait
+    return {"step": step, "origin": "trainer", "wall_s": wall,
+            "components": comp, "comm_blocked_s": blocked,
+            "comm_inflight_s": inflight, "collectives": 0, "io_waits": 0}
+
+
+def test_autotune_off_by_default_is_inert():
+    """GRAFT_AUTOTUNE unset: the observer returns immediately — no
+    decisions, no knob movement (bit-identity with today)."""
+    assert not autotune.enabled()
+    ctrl = autotune.Autotuner(interval=2)
+    before = os.environ.get("GRAFT_BUCKET_BYTES")
+    for i in range(8):
+        ctrl.on_step(_fake_rec(i, data_wait=0.09, blocked=0.05,
+                               inflight=0.05))
+    assert ctrl.decisions() == []
+    assert os.environ.get("GRAFT_BUCKET_BYTES") == before
+
+
+def test_autotune_grows_starved_loader_and_journals(fresh_lens):
+    """The worker-growth loop on a real (tiny) starved DataLoader: a
+    high data_wait window grows workers, the decision lands in the
+    flight-recorder ring, and the cooldown holds the next move back."""
+    from incubator_mxnet_tpu.gluon.data import DataLoader
+    from incubator_mxnet_tpu.gluon.data.dataset import Dataset
+
+    class Slow(Dataset):
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, idx):
+            time.sleep(0.001)
+            return np.zeros((2,), np.float32)
+
+    loader = DataLoader(Slow(), batch_size=2, num_workers=1,
+                        prefetch_device=False)
+    autotune.set_enabled(True)
+    ctrl = autotune.Autotuner(interval=2, cooldown=2, data_wait_bound=0.2,
+                              max_workers=4)
+    try:
+        ctrl.attach_loader(loader)
+        marker = time.time()
+        for i in range(2):
+            ctrl.on_step(_fake_rec(i, data_wait=0.06))
+        assert loader._num_workers == 2
+        grows = [d for d in ctrl.decisions()
+                 if d["target"] == "dataloader_workers"]
+        assert grows == [dict(signal="data_wait",
+                              target="dataloader_workers", old=1, new=2,
+                              cooldown_windows=2,
+                              data_wait_fraction=0.6)]
+        # journaled as a blackbox event
+        evs = [e for e in blackbox.events()
+               if e.get("kind") == "autotune_decision"
+               and e.get("ts", 0) >= marker]
+        assert evs
+        assert evs[-1]["data"]["old"] == 1
+        assert evs[-1]["data"]["new"] == 2
+        assert evs[-1]["data"]["signal"] == "data_wait"
+        # cooldown: the very next starved window must NOT move the knob
+        for i in range(2, 4):
+            ctrl.on_step(_fake_rec(i, data_wait=0.06))
+        assert loader._num_workers == 2
+        # ... but after the cooldown expires it does
+        for i in range(4, 8):
+            ctrl.on_step(_fake_rec(i, data_wait=0.06))
+        assert loader._num_workers == 4
+    finally:
+        autotune.set_enabled(None)
+        loader.close()
+
+
+def test_autotune_bucket_bytes_hill_climb(monkeypatch):
+    """A sagging comm_hidden_ratio shrinks GRAFT_BUCKET_BYTES (the
+    earlier-issue direction first); a move that makes the ratio WORSE
+    flips direction on the next decision."""
+    monkeypatch.setenv("GRAFT_BUCKET_BYTES", str(4 << 20))
+    autotune.set_enabled(True)
+    try:
+        ctrl = autotune.Autotuner(interval=1, cooldown=0,
+                                  comm_hidden_bound=0.6,
+                                  min_bucket_bytes=1 << 20,
+                                  max_bucket_bytes=16 << 20)
+        ps = _build_params(2, prefix="ab")
+        trainer = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01},
+                                kvstore=mx.kv.create("local"))
+        ctrl.attach_trainer(trainer)
+        # window 1: hidden = 1 - 0.08/0.10 = 0.2 < 0.6 -> shrink
+        ctrl.on_step(_fake_rec(1, blocked=0.08, inflight=0.10))
+        assert os.environ["GRAFT_BUCKET_BYTES"] == str(2 << 20)
+        # window 2: ratio got WORSE (0.1) -> direction flips to grow
+        ctrl.on_step(_fake_rec(2, blocked=0.09, inflight=0.10))
+        assert os.environ["GRAFT_BUCKET_BYTES"] == str(4 << 20)
+        moves = [d for d in ctrl.decisions()
+                 if d["target"] == "bucket_bytes"]
+        assert [(-(-d["old"] // d["new"]) if d["old"] > d["new"]
+                 else d["new"] // d["old"]) for d in moves] == [2, 2]
+    finally:
+        autotune.set_enabled(None)
+
+
+def test_pulse_env_memo_tracks_value_changes(fresh_lens, monkeypatch):
+    """The hot-path env flags are memoized keyed on the RAW string:
+    parsing must not run per eager dispatch, but setting the variable
+    mid-process must still take effect immediately."""
+    lens.set_pulse(None)
+    monkeypatch.delenv("GRAFT_PULSE", raising=False)
+    assert lens.pulse_enabled()
+    monkeypatch.setenv("GRAFT_PULSE", "0")
+    assert not lens.pulse_enabled()
+    monkeypatch.setenv("GRAFT_PULSE", "1")
+    assert lens.pulse_enabled()
+    lens.set_enabled(None)      # overrides win: drop to the env path
+    monkeypatch.setenv("GRAFT_LENS", "off")
+    assert not lens.enabled()
+    monkeypatch.delenv("GRAFT_LENS", raising=False)
+    assert lens.enabled()
+    lens.set_enabled(True)      # the fixture's state, for teardown
+
+
+def test_autotune_ignores_non_train_windows():
+    """A train+serve process streams serving windows (origin
+    "serve_batch", data_wait 0, foreign wall) through the same observer:
+    they must not enter decision windows — diluted, data_frac would
+    never cross the bound while the DataLoader starves."""
+    from incubator_mxnet_tpu.gluon.data import DataLoader
+    from incubator_mxnet_tpu.gluon.data.dataset import Dataset
+
+    class Tiny(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, idx):
+            return np.zeros((2,), np.float32)
+
+    loader = DataLoader(Tiny(), batch_size=2, num_workers=1,
+                        prefetch_device=False)
+    autotune.set_enabled(True)
+    try:
+        ctrl = autotune.Autotuner(interval=2, cooldown=0,
+                                  data_wait_bound=0.2, max_workers=4)
+        ctrl.attach_loader(loader)
+        for i in range(6):      # serving windows: big wall, no data_wait
+            ctrl.on_step(dict(_fake_rec(i, wall=1.0),
+                              origin="serve_batch"))
+        assert ctrl.decisions() == []       # never even formed a window
+        for i in range(2):      # the starved TRAIN windows
+            ctrl.on_step(_fake_rec(i, data_wait=0.06))
+        assert loader._num_workers == 2
+        assert len(ctrl.decisions()) == 1
+    finally:
+        autotune.set_enabled(None)
+        loader.close()
+
+
+def test_loader_grown_from_zero_workers_switches_mid_epoch(fresh_lens):
+    """num_workers=0 picks the synchronous path at generator start: a
+    live set_num_workers mid-epoch must switch the OPEN iterator to the
+    pooled pipeline (not silently no-op until next epoch — the
+    autotuner would walk the knob to its cap on zero feedback)."""
+    from incubator_mxnet_tpu.gluon.data import DataLoader
+    from incubator_mxnet_tpu.gluon.data.dataset import Dataset
+
+    class Idx(Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, idx):
+            return np.full((2,), float(idx), np.float32)
+
+    loader = DataLoader(Idx(), batch_size=2, num_workers=0,
+                        prefetch_device=False)
+    try:
+        got = []
+        it = iter(loader)
+        for _ in range(3):
+            got.append(next(it))
+        assert loader._pool is None             # still the sync path
+        loader.set_num_workers(2)
+        for b in it:
+            got.append(b)
+        assert loader._pool is not None, \
+            "grow from 0 never engaged the pooled pipeline mid-epoch"
+        # every batch delivered exactly once, in order
+        flat = np.concatenate([np.asarray(b.asnumpy()).ravel()
+                               for b in got])
+        assert flat.tolist() == [float(v) for v in range(16)
+                                 for _ in (0, 1)]
+    finally:
+        loader.close()
+
+
+def test_autotune_grows_the_loader_the_consumer_blocked_on():
+    """Two registered loaders, the fast one registered FIRST: the grow
+    decision must rank by each loader's blocked-wait delta and grow the
+    one the consumer actually stalled on, not walk the fast loader to
+    the cap in registration order."""
+    from incubator_mxnet_tpu.gluon.data import DataLoader
+    from incubator_mxnet_tpu.gluon.data.dataset import Dataset
+
+    class Tiny(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, idx):
+            return np.zeros((2,), np.float32)
+
+    fast = DataLoader(Tiny(), batch_size=2, num_workers=1,
+                      prefetch_device=False)
+    slow = DataLoader(Tiny(), batch_size=2, num_workers=1,
+                      prefetch_device=False)
+    autotune.set_enabled(True)
+    try:
+        ctrl = autotune.Autotuner(interval=2, cooldown=0,
+                                  data_wait_bound=0.2, max_workers=4)
+        ctrl.attach_loader(fast)            # registration order: fast first
+        ctrl.attach_loader(slow)
+        fast._blocked_wait_s = 0.01
+        slow._blocked_wait_s = 0.50         # the consumer stalled HERE
+        for i in range(2):
+            ctrl.on_step(_fake_rec(i, data_wait=0.06))
+        assert slow._num_workers == 2
+        assert fast._num_workers == 1
+    finally:
+        autotune.set_enabled(None)
+        fast.close()
+        slow.close()
+
+
+def test_autotune_bucket_moves_gated_off_multi_rank(monkeypatch):
+    """Per-rank bucket moves diverge the collective stream (different
+    plans -> mispaired wire -> lockstep fires on a healthy job): the
+    bucket knob must hold still when the process group is > 1."""
+    import jax
+    monkeypatch.setenv("GRAFT_BUCKET_BYTES", str(4 << 20))
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    autotune.set_enabled(True)
+    try:
+        ctrl = autotune.Autotuner(interval=1, cooldown=0,
+                                  comm_hidden_bound=0.6,
+                                  min_bucket_bytes=1 << 20,
+                                  max_bucket_bytes=16 << 20)
+        ps = _build_params(2, prefix="mr")
+        trainer = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01},
+                                kvstore=mx.kv.create("local"))
+        ctrl.attach_trainer(trainer)
+        ctrl.on_step(_fake_rec(1, blocked=0.08, inflight=0.10))
+        assert os.environ["GRAFT_BUCKET_BYTES"] == str(4 << 20)
+        assert ctrl.decisions() == []
+    finally:
+        autotune.set_enabled(None)
+
+
+def test_autotune_validated_move_does_not_flip_on_later_sag(monkeypatch):
+    """A bucket move that RECOVERS the ratio above the bound settles its
+    hill-climb evaluation on that first post-move window — a stale
+    pending flag must not judge an unrelated sag many windows later
+    against the old ratio and walk the knob away from the validated
+    setting."""
+    monkeypatch.setenv("GRAFT_BUCKET_BYTES", str(4 << 20))
+    autotune.set_enabled(True)
+    try:
+        ctrl = autotune.Autotuner(interval=1, cooldown=0,
+                                  comm_hidden_bound=0.6,
+                                  min_bucket_bytes=1 << 20,
+                                  max_bucket_bytes=16 << 20)
+        ps = _build_params(2, prefix="nf")
+        trainer = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01},
+                                kvstore=mx.kv.create("local"))
+        ctrl.attach_trainer(trainer)
+        # window 1: hidden = 0.4 < 0.6 -> shrink (move pending @ 0.4)
+        ctrl.on_step(_fake_rec(1, blocked=0.06, inflight=0.10))
+        assert os.environ["GRAFT_BUCKET_BYTES"] == str(2 << 20)
+        # window 2: the shrink WORKED (0.8 >= bound): the pending move
+        # must settle here, direction stays shrink, no new move
+        ctrl.on_step(_fake_rec(2, blocked=0.02, inflight=0.10))
+        assert os.environ["GRAFT_BUCKET_BYTES"] == str(2 << 20)
+        # window 3: an unrelated later sag (0.35 — below the STALE 0.4)
+        # must hill-climb in the established direction (shrink), not
+        # flip to grow against the long-settled move
+        ctrl.on_step(_fake_rec(3, blocked=0.065, inflight=0.10))
+        assert os.environ["GRAFT_BUCKET_BYTES"] == str(1 << 20)
+    finally:
+        autotune.set_enabled(None)
+
+
+def test_autotune_straggler_feed_repacks_bucket_order():
+    """aggregate-style straggler rows feed the named bucket's lateness
+    into the Trainer's packing tie-breaker and drop the plan cache so
+    the next plan re-packs."""
+    autotune.set_enabled(True)
+    try:
+        ctrl = autotune.Autotuner()
+        ps = _build_params(4, prefix="st")
+        trainer = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01},
+                                kvstore=mx.kv.create("local"))
+        ctrl.attach_trainer(trainer)
+        for _ in range(2):
+            _train_step(ps, trainer, bulk=False)
+        # a local kvstore takes the duplex (store-update) path, so the
+        # plan lands in _duplex_plan_cache; the autotuner checks both
+        cached = getattr(trainer, "_duplex_plan_cache", None) \
+            or getattr(trainer, "_fused_plan_cache", None)
+        assert cached is not None and cached[1] is not None
+        plan = cached[1]
+        label = trainer._sched_label(plan[0][0])
+        matched = ctrl.feed_straggler_table(
+            [{"label": label, "lateness_s": 0.25},
+             {"label": "bucket[nonexistent]", "lateness_s": 1.0}])
+        assert matched == 1
+        assert trainer._duplex_plan_cache is None
+        assert trainer._fused_plan_cache is None
+        for i in plan[0][0].indices:
+            assert trainer._bucket_lateness[i] > 0.0
+        assert any(d["target"] == "bucket_order"
+                   for d in ctrl.decisions())
+        # the next step rebuilds the plan and still trains
+        _train_step(ps, trainer, bulk=False)
+    finally:
+        autotune.set_enabled(None)
+
+
+def test_autotune_selftest_converges():
+    """The lint-tier scenario end-to-end: starved loader -> the
+    controller grows workers until data_wait sinks below the bound."""
+    problems = autotune.selftest(max_steps=60)
+    assert problems == [], problems
+
+
+# ---------------------------------------------------------------------------
+# lockstep online bisection (satellite)
+# ---------------------------------------------------------------------------
+
+def test_lockstep_pins_skipped_collective_online():
+    """A rank that SKIPS one mid-stream collective is not just named —
+    the lagged-prefix points bracket the divergence to adjacent folds
+    and the report pins the exact collective from the local table."""
+    from incubator_mxnet_tpu.analysis import lockstep as ls
+    ls.reset()
+    ls.set_enabled(True)
+    try:
+        def digest(i):
+            return ls._crc("reduce_many|1|%d|%d"
+                           % (4096 + i, ls.keys_digest(["k%d" % i])))
+
+        for i in range(1, 11):
+            ls.fold(i, "reduce_many", n_keys=1, nbytes=4096 + i,
+                    keys=["k%d" % i])
+        # simulate the peer's stream: identical minus collective #5
+        rolling, foldn, points = 0, 0, []
+        for i in [1, 2, 3, 4, 6, 7, 8, 9, 10]:
+            foldn += 1
+            rolling = (rolling * 1000003 + digest(i) + foldn) & 0x7fffffff
+            points.append((foldn, rolling))
+        report = None
+        for k, head in enumerate(points):
+            lagp = points[k - 2] if k >= 2 else (0, 0)
+            report = ls.observe({1: (head[0], head[1],
+                                     lagp[0], lagp[1])}, my_rank=0)
+            if report:
+                break
+        assert report is not None
+        assert report["pinned"] is True
+        assert report["first_divergent_fold"] == 5
+        assert report["last_matching_fold"] == 4
+        assert report["divergent_ranks"] == [1]
+        c = report["divergent_collective"]
+        assert c["path"] == "reduce_many" and c["nbytes"] == 4096 + 5
+        # latched: later heartbeats do not re-report
+        assert ls.observe({1: points[-1] + (0, 0)}, my_rank=0) is None
+        assert ls.divergence()["pinned"] is True
+    finally:
+        ls.reset()
+        ls.set_enabled(None)
+
+
+def test_lockstep_state_lagged_pairs():
+    from incubator_mxnet_tpu.analysis import lockstep as ls
+    ls.reset()
+    ls.set_enabled(True)
+    try:
+        # shorter than the lag: lag half ships (0, 0)
+        ls.fold(1, "reduce_many", n_keys=1, nbytes=1, keys=["a"])
+        f, h, lf, lh = ls.state_lagged()
+        assert (f, lf, lh) == (1, 0, 0) and h != 0
+        for i in range(2, 12):
+            ls.fold(i, "reduce_many", n_keys=1, nbytes=i, keys=["a"])
+        f, h, lf, lh = ls.state_lagged()
+        assert f == 11 and lf == 11 - ls.lag()
+        rows = {r["fold"]: r["rolling"] for r in ls.table()}
+        assert lh == rows[lf]
+        # a healthy laggard (peer = our own lagged prefix) never reports
+        assert ls.observe({1: (lf, lh)}, my_rank=0) is None
+    finally:
+        ls.reset()
+        ls.set_enabled(None)

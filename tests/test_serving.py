@@ -288,6 +288,160 @@ def test_eviction_reload_restores_load_time_weights():
     assert got.tobytes() != net(mx.nd.array(x[None])).asnumpy().tobytes()
 
 
+def test_reload_runs_outside_the_registry_lock():
+    """ISSUE 12 satellite (ROADMAP 11e): a cold model's transparent
+    reload — seconds of parse + H2D in production — must not stall
+    OTHER models' dispatches under the registry lock.  A deliberately
+    gated slow loader holds model a's reload open while the main thread
+    acquires model b: with the reload under the lock this blocks until
+    the gate opens; outside it, b returns immediately."""
+    nets = [_mlp(seed=1), _mlp(seed=2)]
+    x = np.random.RandomState(21).randn(DIN).astype(np.float32)
+    reg = serving.ModelRegistry()
+    ha = reg.load_block("a", nets[0], mx.nd.array(x[None]))
+    reg.load_block("b", nets[1], mx.nd.array(x[None]))
+    assert reg.evict("a") and not ha.resident
+    orig_loader = reg.get("a")._loader
+    started, release = threading.Event(), threading.Event()
+
+    def slow_loader():
+        started.set()
+        release.wait(10.0)
+        return orig_loader()
+
+    reg.get("a")._loader = slow_loader
+    reloader = threading.Thread(target=lambda: reg.acquire("a"),
+                                daemon=True)
+    reloader.start()
+    assert started.wait(10.0)
+    t0 = time.perf_counter()
+    _entry, params, _v = reg.acquire("b")       # must not block on a's
+    blocked_s = time.perf_counter() - t0        # in-flight reload
+    still_loading = not release.is_set() and reloader.is_alive()
+    release.set()
+    reloader.join(10.0)
+    assert still_loading, "gate opened early — the probe proved nothing"
+    assert params and blocked_s < 5.0
+    assert ha.resident                          # a's reload completed
+    y = ha.predict(x[None])
+    ref = nets[0](mx.nd.array(x[None])).asnumpy()
+    assert np.asarray(y).tobytes() == ref.tobytes()
+
+
+def test_reload_latch_serializes_concurrent_acquires():
+    """Concurrent acquires of the SAME cold model run the loader ONCE:
+    followers wait on the per-entry latch (not the registry lock) and
+    then see the installed weights."""
+    net = _mlp(seed=3)
+    x = np.random.RandomState(22).randn(DIN).astype(np.float32)
+    reg = serving.ModelRegistry()
+    ha = reg.load_block("a", net, mx.nd.array(x[None]))
+    assert reg.evict("a")
+    orig_loader = reg.get("a")._loader
+    calls = [0]
+    gate = threading.Event()
+
+    def slow_loader():
+        calls[0] += 1
+        gate.wait(10.0)
+        return orig_loader()
+
+    reg.get("a")._loader = slow_loader
+    results, errors = [], []
+
+    def worker():
+        try:
+            _e, params, version = reg.acquire("a")
+            results.append((len(params), version))
+        except Exception as exc:        # pragma: no cover - failure path
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.1)                     # let every follower reach the latch
+    gate.set()
+    for t in threads:
+        t.join(10.0)
+    assert not errors
+    assert len(results) == 4
+    assert calls[0] == 1, "the latch must serialize to ONE loader run"
+    assert len({r for r in results}) == 1
+    assert reg.reloads_total == 1
+    assert ha.resident
+
+
+def test_reload_failure_releases_latch():
+    """A loader that raises must release the latch so a later acquire
+    retries (and can succeed) instead of deadlocking every waiter."""
+    net = _mlp(seed=4)
+    x = np.random.RandomState(23).randn(DIN).astype(np.float32)
+    reg = serving.ModelRegistry()
+    ha = reg.load_block("a", net, mx.nd.array(x[None]))
+    assert reg.evict("a")
+    orig_loader = reg.get("a")._loader
+    boom = [True]
+
+    def flaky_loader():
+        if boom[0]:
+            raise IOError("weights store down")
+        return orig_loader()
+
+    reg.get("a")._loader = flaky_loader
+    with pytest.raises(IOError):
+        reg.acquire("a")
+    assert reg.get("a")._loading is None        # latch released
+    boom[0] = False
+    _e, params, _v = reg.acquire("a")           # retry succeeds
+    assert params and ha.resident
+    # a loader whose MAPPING is malformed fails INSIDE the locked
+    # install step (past the load itself) — the latch must still open
+    # and a later acquire must still retry, not deadlock every waiter
+    assert reg.evict("a")
+    reg.get("a")._loader = lambda: {"w": object()}   # no .nbytes
+    with pytest.raises(Exception):
+        reg.acquire("a")
+    assert reg.get("a")._loading is None
+    reg.get("a")._loader = orig_loader
+    _e, params, _v = reg.acquire("a")
+    assert params and ha.resident
+
+
+def test_reload_failure_does_not_clobber_successor_latch(monkeypatch):
+    """A reload that fails PAST the install step (which already cleared
+    the latch) must clear only its OWN latch in the failure handler: a
+    successor may have observed ``_loading is None`` and installed a
+    fresh latch — nulling that would let a third thread start a
+    duplicate loader run for the same model."""
+    from incubator_mxnet_tpu.serving import registry as registry_mod
+    net = _mlp(seed=5)
+    x = np.random.RandomState(24).randn(DIN).astype(np.float32)
+    reg = serving.ModelRegistry()
+    reg.load_block("a", net, mx.nd.array(x[None]))
+    assert reg.evict("a")
+    entry = reg.get("a")
+    successor = threading.Event()
+
+    def exploding_nbytes(params):
+        # the install step cleared entry._loading just before this call;
+        # simulate the successor thread that observes None and installs
+        # ITS latch before our failure handler runs
+        entry._loading = successor
+        raise TypeError("malformed mapping")
+
+    with monkeypatch.context() as m:
+        m.setattr(registry_mod, "_nbytes", exploding_nbytes)
+        with pytest.raises(TypeError):
+            reg.acquire("a")
+    assert entry._loading is successor, \
+        "failure handler clobbered the successor's latch"
+    # with the simulated successor gone, a plain retry still succeeds
+    entry._loading = None
+    _e, params, _v = reg.acquire("a")
+    assert params and entry._resident
+
+
 # ---------------------------------------------------------------------------
 # hot-swap
 # ---------------------------------------------------------------------------

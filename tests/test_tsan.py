@@ -646,6 +646,130 @@ def test_gl2xx_suppression_syntax():
     assert g202[0].justification == "advisory counter"
 
 
+_GL_INTERPROC_FIXTURE = textwrap.dedent("""
+    import threading
+    _a_lock = threading.Lock()
+    _b_lock = threading.Lock()
+
+    def grab_b():
+        with _b_lock:
+            pass
+
+    def forward():
+        with _a_lock:
+            grab_b()            # a -> b, one call level deep
+
+    def backward():
+        with _b_lock:
+            with _a_lock:       # b -> a, lexical
+                pass
+
+    class Pipe:
+        def __init__(self):
+            self._x_lock = threading.Lock()
+            self._y_lock = threading.Lock()
+
+        def _grab_y(self):
+            with self._y_lock:
+                pass
+
+        def fwd(self):
+            with self._x_lock:
+                self._grab_y()  # x -> y via a self-method call
+
+        def bwd(self):
+            with self._y_lock:
+                with self._x_lock:
+                    pass
+""")
+
+
+def test_gl201_interprocedural_one_level():
+    """PR 12: a call made while holding lock A contributes A -> every
+    lock the callee's own body acquires — both for bare same-module
+    functions and self-method calls — so cross-function inversions form
+    GL201 cycles."""
+    by = _by_code([d for d in concurrency.lint_source(
+        _GL_INTERPROC_FIXTURE, filename="ip.py") if not d.suppressed])
+    assert "GL201" in by
+    msgs = " | ".join(d.message for d in by["GL201"])
+    assert "_a_lock" in msgs and "_b_lock" in msgs
+    assert "_x_lock" in msgs and "_y_lock" in msgs
+    # drop the lexical halves: the interprocedural edges alone are
+    # acyclic, so no GL201 — one level propagates, nothing fabricates
+    clean = _GL_INTERPROC_FIXTURE.replace(
+        "def backward():\n"
+        "    with _b_lock:\n"
+        "        with _a_lock:       # b -> a, lexical\n"
+        "            pass\n", "").replace(
+        "    def bwd(self):\n"
+        "        with self._y_lock:\n"
+        "            with self._x_lock:\n"
+        "                pass\n", "")
+    assert "backward" not in clean and "bwd" not in clean
+    by2 = _by_code(concurrency.lint_source(clean, filename="ip.py"))
+    assert "GL201" not in by2
+
+
+def test_gl201_nested_def_does_not_collide_with_top_level():
+    """A local closure's lock summary must NOT merge with a same-named
+    top-level function: the fabricated edge would report a deadlock
+    cycle that does not exist in the call graph."""
+    src = textwrap.dedent("""
+        import threading
+        _a_lock = threading.Lock()
+        _b_lock = threading.Lock()
+
+        def helper():
+            pass                    # top-level helper: NO locks
+
+        def runner():
+            def helper():           # unrelated local closure
+                with _a_lock:
+                    pass
+            helper()
+
+        def forward():
+            with _b_lock:
+                helper()            # resolves to the TOP-LEVEL helper
+
+        def backward():
+            with _a_lock:
+                with _b_lock:
+                    pass
+    """)
+    by = _by_code(concurrency.lint_source(src, filename="nest.py"))
+    assert "GL201" not in by
+
+
+def test_gl201_interprocedural_stays_one_level():
+    """Deeper call chains are documented out of scope: holding A and
+    calling f, where only f's CALLEE takes B, must not edge A -> B."""
+    src = textwrap.dedent("""
+        import threading
+        _a_lock = threading.Lock()
+        _b_lock = threading.Lock()
+
+        def leaf():
+            with _b_lock:
+                pass
+
+        def middle():
+            leaf()              # no locks of its own
+
+        def forward():
+            with _a_lock:
+                middle()        # two levels to _b_lock: out of scope
+
+        def backward():
+            with _b_lock:
+                with _a_lock:
+                    pass
+    """)
+    by = _by_code(concurrency.lint_source(src, filename="deep.py"))
+    assert "GL201" not in by
+
+
 def test_gl2xx_repo_is_clean():
     active = [d for d in concurrency.lint_package() if not d.suppressed]
     assert active == [], "\n".join(repr(d) for d in active)

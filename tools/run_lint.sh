@@ -40,6 +40,13 @@
 #    bench_serving.py --smoke emits the serving BENCH JSON (p50/p99 vs
 #    offered QPS) asserting batched dispatch >= 3x the serial
 #    Module.predict loop with bit-equal outputs.
+# 8. graftpulse smoke — telemetry.autotune --selftest runs the synthetic
+#    starved-DataLoader scenario end-to-end: the lens-driven controller
+#    must grow the loader's workers until the data_wait fraction drops
+#    below the bound within a bounded number of steps, with every
+#    decision journaled to the flight recorder; bench_eager --smoke
+#    (tier 3) additionally reports pulse_overhead_pct (the async device
+#    ledger's cost) against its < 2% budget in BENCH JSON.
 #
 # Usage: tools/run_lint.sh [report.json]
 set -uo pipefail
@@ -61,5 +68,8 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m incubator_mxnet_tpu.serving --selftest \
     || exit $?
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_serving.py --smoke \
+    || exit $?
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m incubator_mxnet_tpu.telemetry.autotune --selftest \
     || exit $?
 exec python -m incubator_mxnet_tpu.telemetry --selftest
