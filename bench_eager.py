@@ -526,6 +526,58 @@ def _blackbox_overhead_bench(iters=ITERS, repeats=5):
     }
 
 
+def _armor_overhead_bench(iters=25, repeats=2):
+    """graftarmor inertness: with no faults armed, the PS wire's retry
+    plumbing (request ids, fault_point probes, reconnect bookkeeping)
+    must be ~free.  Times a push/pull loop against a real localhost
+    ParameterServer with GRAFT_FAULTS unset vs armed with a clause that
+    never matches; the delta is reported against the < 2% budget and
+    the armed runs must inject ZERO faults (chaos round, satellite of
+    the robustness PR)."""
+    from incubator_mxnet_tpu.parallel import ps
+    from incubator_mxnet_tpu.armor import faults
+
+    srv = ps.ParameterServer(host="127.0.0.1")
+    client = ps.PSClient(srv.address)
+    grad = {"w": np.ones(1024, np.float32)}
+    fired = 0
+    try:
+        client.init({"w": np.zeros(1024, np.float32)})
+
+        def timed():
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                client.push(grad)
+                client.pull(["w"])
+            return time.perf_counter() - t0
+
+        best = {True: float("inf"), False: float("inf")}
+        for _ in range(repeats):
+            for armed in (False, True):
+                if armed:
+                    faults.configure("bench.never:error:cmd=never")
+                else:
+                    faults.reset()
+                timed()                          # warm this mode
+                best[armed] = min(best[armed], timed())
+                if armed:
+                    fired += sum(r.fires for r in faults.active_rules())
+    finally:
+        faults.reset()
+        client.close()
+        srv.shutdown()
+    pct = (best[True] - best[False]) / best[False] * 100.0
+    if fired:
+        raise AssertionError(
+            "armor chaos round: %d faults fired with a never-matching "
+            "clause armed" % fired)
+    return {
+        "armor_rpc_calls_per_sec": round(2 * iters / best[False], 1),
+        "armor_overhead_pct": round(pct, 2),
+        "armor_faults_fired": fired,
+    }
+
+
 def smoke():
     """Fast path for the lint tier: exercise the bucketed step +
     bit-parity assert in a few seconds, print one JSON line."""
@@ -537,6 +589,7 @@ def smoke():
     res.update(_lens_overhead_bench(iters=10, repeats=3))
     res.update(_pulse_overhead_bench(iters=10, repeats=3))
     res.update(_tsan_overhead_bench(iters=8, repeats=2))
+    res.update(_armor_overhead_bench(iters=25, repeats=2))
     res["metric"] = "fused_step_smoke"
     res["backend"] = jax.default_backend()
     print(json.dumps(res))

@@ -1,0 +1,28 @@
+"""graftarmor — fault injection, self-healing RPC, atomic checkpointing.
+
+The robustness layer (ISSUE 15 / docs/robustness.md), four pieces:
+
+* :mod:`.faults` — ``GRAFT_FAULTS`` deterministic fault injection into
+  the real PS/collective/dataloader/serving code paths.
+* the self-healing PS wire lives in :mod:`..parallel.ps` (per-call
+  timeouts, reconnect + bounded backoff, idempotent retry ids) — armor
+  supplies its typed failures and chaos sites.
+* :mod:`.checkpoint` — atomic step-consistent snapshot/restore of
+  params + optimizer state + step + RNG, with auto-resume.
+* typed hang escalation rides :mod:`..telemetry.watchdog`
+  (``GRAFT_WATCHDOG_ESCALATE``) using :mod:`.errors`.
+
+Everything is off by default and bit-inert when off; ``python -m
+incubator_mxnet_tpu.armor --selftest`` proves the machinery end to end.
+"""
+from __future__ import annotations
+
+from .errors import (ArmorError, FaultInjectedError, PSUnavailableError,
+                     CollectiveTimeoutError, CheckpointCorruptError)
+from .faults import fault_point, configure, reset, active_rules, set_rank
+
+__all__ = [
+    "ArmorError", "FaultInjectedError", "PSUnavailableError",
+    "CollectiveTimeoutError", "CheckpointCorruptError",
+    "fault_point", "configure", "reset", "active_rules", "set_rank",
+]

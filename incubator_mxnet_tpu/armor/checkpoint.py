@@ -1,0 +1,390 @@
+"""graftarmor atomic checkpoint / auto-resume.
+
+A checkpoint is a *step-consistent* snapshot — params + optimizer
+states + step counter + RNG captured only after every in-flight
+reduce/pull handle has drained, so no torn bucket is ever persisted —
+written **tmp-then-rename** so a crash mid-write can never destroy the
+previous good snapshot, and self-validating: the payload rides behind a
+fixed magic header carrying its own SHA-256, and a human-readable
+``.manifest.json`` sidecar mirrors the hash for external tooling.
+
+Layout (one file per snapshot)::
+
+    GRAFTARMOR1\\n            magic (12 bytes)
+    <sha256: 32 bytes>        digest of the payload
+    <length: 8 bytes LE>      payload byte count
+    <payload>                 pickled state dict (format graft-armor/1)
+
+Entry points:
+
+* :func:`save_state` / :func:`load_state` — raw state dicts, validated;
+  loads raise :class:`~.errors.CheckpointCorruptError` on a bad magic,
+  hash mismatch, or truncation (never a pickle traceback).
+* :func:`snapshot_trainer` / :func:`restore_trainer` — capture/restore
+  a ``gluon.Trainer`` (params, local or store-side Updater states,
+  RNG).  dist_async optimizer state lives on the parameter server and
+  is not captured (the same restriction ``Trainer.save_states`` keeps);
+  the restored *weights* re-seed the server through the normal
+  ``kvstore.init`` first-push-wins path on restart.
+* :class:`Checkpointer` — periodic ``GRAFT_CHECKPOINT_EVERY`` saves
+  into a directory of ``ckpt-<step>.armor`` files, ``resume()`` from
+  the newest *valid* one (corrupt/truncated snapshots are skipped, not
+  fatal), and a best-effort emergency snapshot hooked into the flight
+  recorder's SIGTERM chain.
+
+Everything here is inert unless called: no env var is read at import,
+and a Trainer without a Checkpointer never touches this module.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import re
+import struct
+import time
+
+import numpy as np
+
+from .errors import CheckpointCorruptError
+
+__all__ = ["FORMAT", "save_state", "load_state", "manifest_of",
+           "snapshot_trainer", "restore_trainer", "Checkpointer",
+           "fast_forward", "configured_every"]
+
+FORMAT = "graft-armor/1"
+_MAGIC = b"GRAFTARMOR1\n"
+_LEN = struct.Struct("<Q")
+
+
+def configured_every():
+    """GRAFT_CHECKPOINT_EVERY in steps, or None when unset/invalid."""
+    raw = os.environ.get("GRAFT_CHECKPOINT_EVERY", "").strip()
+    if not raw:
+        return None
+    try:
+        n = int(raw)
+    except ValueError:
+        return None
+    return n if n > 0 else None
+
+
+# -- the wire format --------------------------------------------------------
+
+def save_state(path, state):
+    """Atomically persist one state dict: serialize, hash, write to a
+    same-directory tmp file, fsync, ``os.replace`` — readers only ever
+    see the old snapshot or the complete new one.  Returns the manifest
+    dict (also written to ``<path>.manifest.json``)."""
+    state = dict(state, format=FORMAT)
+    payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+    digest = hashlib.sha256(payload).digest()
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "wb") as f:
+        f.write(_MAGIC)
+        f.write(digest)
+        f.write(_LEN.pack(len(payload)))
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    manifest = {"format": FORMAT, "sha256": digest.hex(),
+                "nbytes": len(payload), "step": state.get("step"),
+                "saved_at": time.time(),
+                "params": sorted(state.get("params", {}))}
+    mtmp = "%s.manifest.json.tmp.%d" % (path, os.getpid())
+    try:
+        with open(mtmp, "w") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
+        os.replace(mtmp, path + ".manifest.json")
+    except OSError:
+        pass        # the sidecar is informational; the snapshot is whole
+    from ..telemetry import blackbox as _blackbox
+    _blackbox.record("checkpoint_saved", path=str(path),
+                     step=state.get("step"), nbytes=len(payload))
+    return manifest
+
+
+def load_state(path):
+    """Load + validate one snapshot.  Every corruption mode — missing
+    file, bad magic, short read, hash mismatch, unpicklable payload,
+    wrong format tag — surfaces as :class:`CheckpointCorruptError` with
+    the reason, so resume loops can skip to an older snapshot."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as exc:
+        raise CheckpointCorruptError(path, "unreadable: %s" % exc)
+    if not raw.startswith(_MAGIC):
+        raise CheckpointCorruptError(path, "bad magic (not an armor "
+                                     "checkpoint)")
+    head = len(_MAGIC)
+    if len(raw) < head + 32 + _LEN.size:
+        raise CheckpointCorruptError(path, "truncated header")
+    digest = raw[head:head + 32]
+    (n,) = _LEN.unpack(raw[head + 32:head + 32 + _LEN.size])
+    payload = raw[head + 32 + _LEN.size:]
+    if len(payload) != n:
+        raise CheckpointCorruptError(
+            path, "truncated payload (%d of %d bytes)" % (len(payload), n))
+    if hashlib.sha256(payload).digest() != digest:
+        raise CheckpointCorruptError(path, "sha256 mismatch")
+    try:
+        state = pickle.loads(payload)
+    except Exception as exc:
+        raise CheckpointCorruptError(path, "unpicklable payload: %r" % exc)
+    if not isinstance(state, dict) or state.get("format") != FORMAT:
+        raise CheckpointCorruptError(
+            path, "format is %r, expected %r"
+            % (state.get("format") if isinstance(state, dict) else None,
+               FORMAT))
+    return state
+
+
+def manifest_of(path):
+    """The sidecar manifest (or None) — tooling convenience."""
+    try:
+        with open(path + ".manifest.json") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+# -- Trainer capture/restore ------------------------------------------------
+
+def _drain(trainer):
+    """Settle every in-flight handle the trainer may hold so the capture
+    is step-consistent: outstanding duplex weight pulls, then queued
+    dist_async pushes (read-your-writes against the parameter server)."""
+    sched = getattr(trainer, "_pull_scheduler", None)
+    if sched is not None:
+        sched.finish()
+    kv = getattr(trainer, "_kvstore_obj", None)
+    drain = getattr(kv, "_drain_pushes", None)
+    if drain is not None:
+        drain()
+    return kv
+
+
+def _updater_states(trainer):
+    """The optimizer-state bytes this process owns, or None (dist_async:
+    state lives on the parameter server — same save_states restriction)."""
+    if getattr(trainer, "_kv_initialized", False) \
+            and getattr(trainer, "_update_on_kvstore", False):
+        updater = trainer._kvstore_obj._updater
+        if updater is None:
+            return None
+        return updater.get_states(dump_optimizer=True)
+    return trainer._updaters[0].get_states(dump_optimizer=True)
+
+
+def snapshot_trainer(trainer, step, extra=None):
+    """Build the state dict for one trainer: drains first, then pulls
+    authoritative weights from a dist_async parameter server (the local
+    mirror may be stale), then captures params/optimizer/RNG/step."""
+    from .. import random_state as _random_state
+    kv = _drain(trainer)
+    if kv is not None and getattr(kv, "_ps", None) is not None:
+        # dist_async: the SERVER holds the weights; refresh local copies
+        # so the snapshot captures what training actually converged to
+        keys = [i for i in range(len(trainer._params))]
+        kv.pull(keys, [p.list_data() for p in trainer._params])
+    params = {}
+    for p in trainer._params:
+        params[p.name] = np.asarray(p.list_data()[0]._read())
+    return {
+        "format": FORMAT,
+        "step": int(step),
+        "params": params,
+        "optimizer": _updater_states(trainer),
+        "rng": _random_state.get_state(),
+        "saved_at": time.time(),
+        "extra": dict(extra or {}),
+    }
+
+
+def restore_trainer(trainer, state):
+    """Write a snapshot back onto a trainer: params to every context
+    replica, optimizer states to the local updaters (or the store-side
+    updater when it owns the update), RNG to this thread.  Restoring
+    BEFORE the first step re-seeds dist stores through the normal
+    ``_init_kvstore`` broadcast/init path."""
+    import jax.numpy as jnp
+    from .. import random_state as _random_state
+    params = state.get("params", {})
+    by_name = {p.name: p for p in trainer._params}
+    missing = sorted(set(by_name) - set(params))
+    if missing:
+        raise CheckpointCorruptError(
+            "<state>", "snapshot lacks params: %s" % missing[:5])
+    for name, val in params.items():
+        p = by_name.get(name)
+        if p is None:
+            continue            # extra param in snapshot: ignore
+        for d in p.list_data():
+            d._write(jnp.asarray(val).astype(d.dtype))
+    opt_bytes = state.get("optimizer")
+    if opt_bytes is not None:
+        if getattr(trainer, "_kv_initialized", False) \
+                and getattr(trainer, "_update_on_kvstore", False) \
+                and trainer._kvstore_obj._updater is not None:
+            trainer._kvstore_obj._updater.set_states(opt_bytes)
+        else:
+            for updater in trainer._updaters:
+                updater.set_states(opt_bytes)
+    rng = state.get("rng")
+    if rng is not None:
+        _random_state.set_state(rng)
+    # NOTE: restore is a RESTART-time operation.  On dist stores the
+    # restored local values reach the wire through the normal
+    # ``_init_kvstore`` path (rank-0 broadcast on dist_sync; first-push
+    # init on a fresh dist_async server) — restoring into a trainer
+    # whose kvstore is already live only changes the local replicas,
+    # exactly like any other user weight write between steps.
+    return int(state.get("step", 0))
+
+
+def fast_forward(data_iter, n):
+    """Advance a data iterator ``n`` batches (the resume contract: the
+    restored step has consumed the first ``n``).  Epoch boundaries are
+    honored when the iterator exposes ``reset()`` (the io.DataIter
+    protocol); a plain short iterable just stops early."""
+    it = iter(data_iter)
+    skipped = 0
+    while skipped < n:
+        try:
+            next(it)
+            skipped += 1
+        except StopIteration:
+            reset = getattr(data_iter, "reset", None)
+            if reset is None:
+                break
+            reset()
+            it = iter(data_iter)
+    return skipped
+
+
+class Checkpointer(object):
+    """Periodic + emergency checkpointing for one trainer.
+
+    ``step_end(step)`` is the training-loop hook: every
+    ``GRAFT_CHECKPOINT_EVERY`` steps (or the ``every`` argument) it
+    writes ``ckpt-<step>.armor`` into ``directory`` and prunes old
+    snapshots down to ``keep``.  ``resume()`` restores the newest VALID
+    snapshot (corrupt ones are skipped with a ring event, never fatal)
+    and returns its step so the caller can fast-forward its data.  When
+    ``emergency`` is on, a SIGTERM/SIGINT lands one last best-effort
+    snapshot through the flight recorder's signal chain before the
+    process dies."""
+
+    def __init__(self, trainer, directory, every=None, keep=2,
+                 emergency=True):
+        from ..telemetry import blackbox as _blackbox
+        self.trainer = trainer
+        self.directory = str(directory)
+        self.every = every if every is not None else configured_every()
+        self.keep = max(1, int(keep))
+        self.last_step = None
+        self._emergency_hook = None
+        os.makedirs(self.directory, exist_ok=True)
+        if emergency:
+            def _on_signal(signum, _self=self):
+                _self.save(step=_self.last_step or 0,
+                           tag="emergency")
+            self._emergency_hook = _on_signal
+            _blackbox.register_emergency(_on_signal)
+
+    def close(self):
+        from ..telemetry import blackbox as _blackbox
+        if self._emergency_hook is not None:
+            _blackbox.unregister_emergency(self._emergency_hook)
+            self._emergency_hook = None
+
+    # -- saving -------------------------------------------------------------
+    def _path(self, step, tag=None):
+        name = "ckpt-%08d%s.armor" % (int(step),
+                                      ("-" + tag) if tag else "")
+        return os.path.join(self.directory, name)
+
+    def save(self, step, tag=None):
+        """One snapshot now.  Returns the path written."""
+        from ..telemetry import metrics as _tmetrics
+        t0 = time.perf_counter()
+        state = snapshot_trainer(self.trainer, step)
+        path = self._path(step, tag=tag)
+        manifest = save_state(path, state)
+        _tmetrics.checkpoint_saved(time.perf_counter() - t0,
+                                   manifest["nbytes"], int(step))
+        self.last_step = int(step)
+        if tag is None:
+            self._prune()
+        return path
+
+    def step_end(self, step):
+        """Training-loop hook: save when the period divides ``step``.
+        With no period configured this is a two-attribute no-op."""
+        self.last_step = int(step)
+        if self.every and step > 0 and step % self.every == 0:
+            return self.save(step)
+        return None
+
+    def _prune(self):
+        snaps = self._scan()
+        for step, path in snaps[:-self.keep]:
+            for p in (path, path + ".manifest.json"):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
+    def _scan(self):
+        """[(step, path)] of periodic snapshots, oldest first (emergency
+        ones — tagged filenames — sort by their step too)."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            m = re.match(r"ckpt-(\d+)(?:-[\w.-]+)?\.armor$", name)
+            if m:
+                out.append((int(m.group(1)),
+                            os.path.join(self.directory, name)))
+        out.sort()
+        return out
+
+    # -- resuming -----------------------------------------------------------
+    def latest_valid(self):
+        """(step, path, state) of the newest snapshot that passes
+        validation, or None.  Corrupt/truncated snapshots are skipped
+        (recorded in the ring) — the resume contract is the last VALID
+        state, not the last write attempt."""
+        from ..telemetry import blackbox as _blackbox
+        for step, path in reversed(self._scan()):
+            try:
+                return step, path, load_state(path)
+            except CheckpointCorruptError as exc:
+                _blackbox.record("checkpoint_skipped", path=path,
+                                 reason=str(exc))
+        return None
+
+    def resume(self, data_iter=None):
+        """Restore the newest valid snapshot onto the trainer.  Returns
+        the restored step (0 when there is nothing to resume).  With a
+        ``data_iter`` the iterator is fast-forwarded by that many
+        batches so the next batch is the one the dead run would have
+        consumed."""
+        from ..telemetry import blackbox as _blackbox
+        from ..telemetry import metrics as _tmetrics
+        found = self.latest_valid()
+        if found is None:
+            return 0
+        step, path, state = found
+        restore_trainer(self.trainer, state)
+        self.last_step = step
+        if data_iter is not None:
+            fast_forward(data_iter, step)
+        _blackbox.record("checkpoint_restored", path=path, step=step)
+        _tmetrics.checkpoint_restored(step)
+        return step

@@ -1,0 +1,80 @@
+"""graftarmor typed failure taxonomy.
+
+Every failure the armor subsystem can surface is a *typed* exception
+carrying the evidence a supervisor needs to act: which RPC command gave
+up after how many attempts, which collective timed out against which
+dead ranks, which checkpoint failed its manifest.  Catching
+:class:`ArmorError` catches all of them; nothing here imports anything,
+so any layer (the watchdog thread included) can raise these without
+circular-import risk.
+"""
+
+__all__ = ["ArmorError", "FaultInjectedError", "PSUnavailableError",
+           "CollectiveTimeoutError", "CheckpointCorruptError"]
+
+
+class ArmorError(RuntimeError):
+    """Base of every typed robustness failure."""
+
+
+class FaultInjectedError(ArmorError):
+    """An injected ``kind=error`` fault (armor/faults.py) — chaos, not a
+    real failure; the site name travels in ``.site`` so post-mortems can
+    tell the two apart without parsing messages."""
+
+    def __init__(self, site, detail=None):
+        super().__init__("injected fault at %r%s"
+                         % (site, (" (%s)" % detail) if detail else ""))
+        self.site = site
+
+
+class PSUnavailableError(ArmorError):
+    """A parameter-service RPC exhausted its retry budget.  ``cmd`` is
+    the RPC verb, ``attempts`` how many tries were burned, ``dead_ranks``
+    whatever the heartbeat table knew when we gave up (may be empty —
+    the server itself being gone reports no table at all)."""
+
+    def __init__(self, cmd, attempts, last_error=None, dead_ranks=()):
+        msg = ("parameter service unavailable: %r failed after %d "
+               "attempt%s" % (cmd, attempts, "" if attempts == 1 else "s"))
+        if dead_ranks:
+            msg += "; dead ranks: %s" % list(dead_ranks)
+        if last_error is not None:
+            msg += " (last error: %r)" % (last_error,)
+        super().__init__(msg)
+        self.cmd = cmd
+        self.attempts = attempts
+        self.last_error = last_error
+        self.dead_ranks = tuple(dead_ranks)
+
+
+class CollectiveTimeoutError(ArmorError):
+    """A collective/RPC bracket outlived the watchdog timeout and
+    GRAFT_WATCHDOG_ESCALATE asked for a raise instead of a hang.  Names
+    the stuck site, its age, and the dead ranks the heartbeat table
+    reported — the fail-fast alternative to waiting for SIGKILL."""
+
+    def __init__(self, site, age_s, timeout_s, dead_ranks=(), detail=None):
+        msg = ("collective %r stuck for %.1fs (watchdog timeout %.1fs)"
+               % (site, age_s, timeout_s))
+        if dead_ranks:
+            msg += "; dead ranks: %s" % list(dead_ranks)
+        if detail:
+            msg += "; detail: %r" % (detail,)
+        super().__init__(msg)
+        self.site = site
+        self.age_s = age_s
+        self.timeout_s = timeout_s
+        self.dead_ranks = tuple(dead_ranks)
+        self.detail = detail
+
+
+class CheckpointCorruptError(ArmorError):
+    """A snapshot failed structural validation or its manifest hash —
+    the loader refuses to resume from it (resume falls back to the
+    previous snapshot; model.resume_from_checkpoint skips the epoch)."""
+
+    def __init__(self, path, reason):
+        super().__init__("checkpoint %s is not loadable: %s" % (path, reason))
+        self.path = str(path)
+        self.reason = reason

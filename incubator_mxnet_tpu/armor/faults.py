@@ -1,0 +1,243 @@
+"""graftarmor deterministic fault injection.
+
+``GRAFT_FAULTS`` threads named chaos into the REAL code paths — the PS
+RPC wire, collective issue/wait, the DataLoader worker, the serving
+dispatcher — so the recovery machinery is exercised by the same calls
+production takes, not by mocks.  Injection is deterministic: which
+arrival at a site fires is decided by counters and a seeded PRNG, never
+by wall clock, so a chaos run replays bit-identically.
+
+Spec grammar (documented in docs/robustness.md)::
+
+    GRAFT_FAULTS = clause (";" clause)*
+    clause      = site ":" kind (":" key "=" value)*
+    site        = dotted site name; trailing "*" is a prefix wildcard
+    kind        = drop | delay | error | disconnect | kill
+
+Selector keys (all optional):
+
+* ``n=K``     — fire on the K-th arrival at the site (1-based), once.
+* ``every=K`` — fire on every K-th arrival.
+* ``p=F``     — fire each arrival with probability F (seeded PRNG).
+* ``times=N`` — cap total fires (default 1 for ``n=``, unlimited
+  otherwise).
+* ``ms=N``    — duration for ``kind=delay`` (default 50).
+* ``seed=S``  — PRNG seed for ``p=`` (default 0; folded with the site
+  name so two probabilistic clauses never share a stream).
+* ``rank=R``  — only fire on worker rank R (see :func:`set_rank`).
+* any other ``key=value`` must match the keyword context the site
+  passes to :func:`fault_point` (e.g. ``cmd=push`` on the PS wire).
+
+Kind semantics are generic where possible: ``delay`` sleeps ``ms``
+milliseconds inside :func:`fault_point`; ``error`` raises
+:class:`~.errors.FaultInjectedError`; ``kill`` is ``os._exit(137)`` —
+the kill-rank-mid-step harness for multi-process tests.  ``drop`` and
+``disconnect`` are returned as strings for the site to interpret (the
+PS wire turns them into a swallowed send / a closed socket, exercising
+its timeout and reconnect paths); a site that receives a kind it cannot
+express ignores it.
+
+Every fired fault lands in the flight recorder as a ``fault_injected``
+event and bumps ``graft_faults_injected_total{site,kind}``, so a chaos
+post-mortem can separate injected failures from real ones.  With
+``GRAFT_FAULTS`` unset the whole module is a near-no-op: one environment
+lookup against a memoized raw string per call.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+import zlib
+
+from .errors import FaultInjectedError
+
+__all__ = ["fault_point", "configure", "reset", "active_rules",
+           "set_rank", "KINDS"]
+
+KINDS = ("drop", "delay", "error", "disconnect", "kill")
+
+_SELECTOR_KEYS = ("n", "every", "p", "times", "ms", "seed", "rank")
+
+_lock = threading.Lock()
+_raw = [None]           # the GRAFT_FAULTS string the rules were built from
+_rules = []             # parsed _Rule list (empty = injection disabled)
+_rank = [None]          # worker rank for rank= filters (set_rank)
+
+
+class _Rule(object):
+    __slots__ = ("site", "prefix", "kind", "n", "every", "p", "times",
+                 "ms", "match", "rank", "rng", "arrivals", "fires")
+
+    def __init__(self, site, kind, opts):
+        self.prefix = site.endswith("*")
+        self.site = site[:-1] if self.prefix else site
+        self.kind = kind
+        self.n = int(opts["n"]) if "n" in opts else None
+        self.every = int(opts["every"]) if "every" in opts else None
+        self.p = float(opts["p"]) if "p" in opts else None
+        default_times = 1 if (self.n is not None
+                              and self.every is None
+                              and self.p is None) else None
+        self.times = int(opts["times"]) if "times" in opts else default_times
+        self.ms = float(opts.get("ms", 50.0))
+        self.rank = int(opts["rank"]) if "rank" in opts else None
+        seed = int(opts.get("seed", 0))
+        self.rng = random.Random(seed ^ zlib.crc32(site.encode()))
+        self.match = {k: v for k, v in opts.items()
+                      if k not in _SELECTOR_KEYS}
+        self.arrivals = 0
+        self.fires = 0
+
+    def wants(self, site, ctx):
+        if self.prefix:
+            if not site.startswith(self.site):
+                return False
+        elif site != self.site:
+            return False
+        if self.rank is not None and self.rank != _rank[0]:
+            return False
+        for k, v in self.match.items():
+            if str(ctx.get(k)) != v:
+                return False
+        return True
+
+    def decide(self):
+        """One arrival reached a matching rule: fire?  Counter- and
+        PRNG-driven only — replays are deterministic."""
+        self.arrivals += 1
+        if self.times is not None and self.fires >= self.times:
+            return False
+        if self.n is not None and self.arrivals == self.n:
+            self.fires += 1
+            return True
+        if self.every is not None and self.arrivals % self.every == 0:
+            self.fires += 1
+            return True
+        if self.p is not None and self.rng.random() < self.p:
+            self.fires += 1
+            return True
+        if self.n is None and self.every is None and self.p is None:
+            self.fires += 1     # bare clause: every matching arrival
+            return True
+        return False
+
+
+def _parse(raw):
+    rules = []
+    for clause in (raw or "").split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        parts = clause.split(":")
+        if len(parts) < 2:
+            raise ValueError("GRAFT_FAULTS clause %r: want site:kind[:k=v...]"
+                             % clause)
+        site, kind = parts[0].strip(), parts[1].strip().lower()
+        if kind not in KINDS:
+            raise ValueError("GRAFT_FAULTS clause %r: unknown kind %r "
+                             "(want one of %s)" % (clause, kind, list(KINDS)))
+        opts = {}
+        for kv in parts[2:]:
+            if "=" not in kv:
+                raise ValueError("GRAFT_FAULTS clause %r: bad option %r"
+                                 % (clause, kv))
+            k, v = kv.split("=", 1)
+            opts[k.strip()] = v.strip()
+        rules.append(_Rule(site, kind, opts))
+    return rules
+
+
+def configure(spec):
+    """Install a fault spec programmatically (tests/selftest).  Passing
+    None/"" clears every rule.  Counters reset — a fresh configure is a
+    fresh deterministic replay.  The env var is updated to match: the
+    hot path memoizes on the raw GRAFT_FAULTS string, so a programmatic
+    spec that left the env untouched would be clobbered by the next
+    :func:`fault_point`'s staleness check."""
+    with _lock:
+        if spec:
+            os.environ["GRAFT_FAULTS"] = spec
+        else:
+            os.environ.pop("GRAFT_FAULTS", None)
+        _raw[0] = os.environ.get("GRAFT_FAULTS")
+        _rules[:] = _parse(_raw[0])
+    return list(_rules)
+
+
+def reset():
+    """Drop all rules (clears GRAFT_FAULTS — see :func:`configure`)."""
+    with _lock:
+        os.environ.pop("GRAFT_FAULTS", None)
+        _raw[0] = None
+        _rules[:] = []
+
+
+def active_rules():
+    """The live rule list (selftest/debug introspection)."""
+    _refresh()
+    return list(_rules)
+
+
+def set_rank(r):
+    """Stamp this process's worker rank for ``rank=`` clause filters
+    (DistKVStore calls it next to blackbox.set_rank)."""
+    _rank[0] = None if r is None else int(r)
+
+
+def _refresh():
+    raw = os.environ.get("GRAFT_FAULTS")
+    if raw != _raw[0]:
+        with _lock:
+            if raw != _raw[0]:      # double-checked: one thread parses
+                _rules[:] = _parse(raw)
+                _raw[0] = raw
+
+
+def _record(site, kind, rule, ctx):
+    from ..telemetry import blackbox as _blackbox
+    from ..telemetry import metrics as _tmetrics
+    fields = {k: v for k, v in ctx.items()
+              if isinstance(v, (str, int, float, bool, type(None)))}
+    fields.pop("site", None)
+    _blackbox.record("fault_injected", site=site, fault=kind,
+                     arrival=rule.arrivals, fire=rule.fires, **fields)
+    _tmetrics.fault_injected(site, kind)
+
+
+def fault_point(site, **ctx):
+    """One named chaos site.  Returns None (the overwhelmingly common
+    case — no spec, or no matching rule fired) or the fault kind the
+    CALLER must act out (``"drop"``/``"disconnect"``); ``delay`` sleeps
+    here, ``error`` raises :class:`FaultInjectedError` here, ``kill``
+    exits the process here.  Disabled cost is one env lookup against a
+    memoized string."""
+    raw = os.environ.get("GRAFT_FAULTS")
+    if raw != _raw[0]:
+        _refresh()
+    if not _rules:
+        return None
+    with _lock:
+        fired = None
+        for rule in _rules:
+            if rule.wants(site, ctx) and rule.decide():
+                fired = rule
+                break
+    if fired is None:
+        return None
+    _record(site, fired.kind, fired, ctx)
+    if fired.kind == "delay":
+        time.sleep(fired.ms / 1000.0)
+        return None
+    if fired.kind == "error":
+        raise FaultInjectedError(site, detail=ctx.get("cmd"))
+    if fired.kind == "kill":
+        # the kill-rank-mid-step harness: flush the flight recorder's
+        # evidence, then die the way a preempted host dies — no cleanup
+        import sys
+        sys.stderr.write("graftarmor: injected kill at %r (rank=%r)\n"
+                         % (site, _rank[0]))
+        sys.stderr.flush()
+        os._exit(137)
+    return fired.kind        # drop / disconnect: the site acts it out

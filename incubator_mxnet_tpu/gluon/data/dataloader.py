@@ -170,6 +170,10 @@ class DataLoader(object):
         pool = self._worker_pool()
 
         def make(batch):
+            # graftarmor chaos site: a worker-thread batch build can be
+            # delayed (slow disk) or failed (bad record) by GRAFT_FAULTS
+            from ...armor import faults as _faults
+            _faults.fault_point("dataloader.worker", n=len(batch))
             out = self._batchify_fn([self._dataset[idx] for idx in batch])
             if prefetch:
                 # the lookahead batch's host→device transfer goes on the

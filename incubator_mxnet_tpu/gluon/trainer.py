@@ -766,3 +766,32 @@ class Trainer(object):
             self._optimizer = self._updaters[0].optimizer
         self._optimizer.param_dict = {i: param
                                       for i, param in enumerate(self._params)}
+
+    # -- graftarmor atomic checkpoint/auto-resume ---------------------------
+    def checkpointer(self, directory, every=None, keep=2, emergency=True):
+        """A :class:`~incubator_mxnet_tpu.armor.checkpoint.Checkpointer`
+        bound to this trainer: call ``ckpt.step_end(step)`` each step for
+        periodic (GRAFT_CHECKPOINT_EVERY) atomic snapshots of params +
+        optimizer state + step + RNG, ``ckpt.resume(data_iter)`` after a
+        restart for last-valid-snapshot auto-resume, and get a
+        best-effort emergency snapshot from the SIGTERM hook for free."""
+        from ..armor.checkpoint import Checkpointer
+        return Checkpointer(self, directory, every=every, keep=keep,
+                            emergency=emergency)
+
+    def save_checkpoint(self, path, step=0):
+        """One atomic full-state snapshot (params + optimizer states +
+        ``step`` + RNG) at ``path`` — in-flight async pushes/pulls are
+        drained first so the snapshot is step-consistent.  See
+        :mod:`~incubator_mxnet_tpu.armor.checkpoint`."""
+        from ..armor import checkpoint as _ckpt
+        return _ckpt.save_state(path, _ckpt.snapshot_trainer(self, step))
+
+    def load_checkpoint(self, path):
+        """Restore a :meth:`save_checkpoint` snapshot (validated against
+        its embedded hash; raises ``CheckpointCorruptError`` on damage);
+        returns the step the snapshot was taken at."""
+        from ..armor import checkpoint as _ckpt
+        state = _ckpt.load_state(path)
+        _ckpt.restore_trainer(self, state)
+        return int(state.get("step", 0))

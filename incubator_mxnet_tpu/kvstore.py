@@ -29,6 +29,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from .analysis import tsan as _tsan
+from .armor import faults as _faults
 from .base import MXNetError
 from .ndarray import NDArray
 from .ndarray import ndarray as _nd
@@ -138,6 +139,12 @@ class _AsyncHandle(object):
             self._begin_wait()
             t0 = time.perf_counter()
             try:
+                # graftarmor chaos site: the wait side of every issued
+                # collective (delay models a straggler; error a failed
+                # wire) — injected BEFORE the block so the bracket
+                # closes through the normal finally path
+                _faults.fault_point("collective.wait", label=self.label,
+                                    n_values=len(self.values))
                 self._materialize()
                 import jax
                 jax.block_until_ready([v._read() for v in self.values])
@@ -367,6 +374,9 @@ class KVStore(object):
             # its open time before that measures healthy overlap
             entry["async_pending"] = True
         try:
+            # graftarmor chaos site: the issue side of the async wire
+            _faults.fault_point("collective.issue", label=label,
+                                n_values=len(values))
             self._cross_worker_reduce_many(values, heartbeat=False)
         except BaseException:
             bracket.__exit__(*sys.exc_info())

@@ -32,6 +32,18 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     logging.info('Saved checkpoint to "%s"', param_name)
 
 
+def _checkpoint_epochs(prefix):
+    """Every epoch with a ``prefix-NNNN.params`` file, ascending."""
+    import glob
+    import re
+    epochs = []
+    for path in glob.glob(glob.escape(prefix) + "-*.params"):
+        m = re.match(re.escape(prefix) + r"-(\d{4,})\.params$", path)
+        if m:
+            epochs.append(int(m.group(1)))
+    return sorted(epochs)
+
+
 def latest_checkpoint(prefix):
     """Highest epoch number with a ``prefix-NNNN.params`` file, or None.
 
@@ -39,27 +51,33 @@ def latest_checkpoint(prefix):
     checkpoint-based auto-resume loop"): pair with
     :func:`resume_from_checkpoint` to restart training after a failure.
     """
-    import glob
-    import re
-    best = None
-    for path in glob.glob(glob.escape(prefix) + "-*.params"):
-        m = re.match(re.escape(prefix) + r"-(\d{4,})\.params$", path)
-        if m:
-            e = int(m.group(1))
-            best = e if best is None else max(best, e)
-    return best
+    epochs = _checkpoint_epochs(prefix)
+    return epochs[-1] if epochs else None
 
 
 def resume_from_checkpoint(prefix):
     """(symbol, arg_params, aux_params, begin_epoch) from the newest
-    checkpoint, or (None, None, None, 0) when none exists — feed straight
-    into ``Module.fit(arg_params=..., begin_epoch=...)`` for crash-safe
-    restarts."""
-    epoch = latest_checkpoint(prefix)
-    if epoch is None:
-        return None, None, None, 0
-    symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
-    return symbol, arg_params, aux_params, epoch
+    LOADABLE checkpoint, or (None, None, None, 0) when none exists —
+    feed straight into ``Module.fit(arg_params=..., begin_epoch=...)``
+    for crash-safe restarts.
+
+    Robustness contract (graftarmor): a corrupt or truncated newest
+    checkpoint — a host killed mid-save under a pre-atomic writer, a
+    half-copied file — is SKIPPED with a warning and the walk falls back
+    to the next-older epoch, so resume lands on the last epoch whose
+    bytes actually load.  (nd.save itself now publishes atomically via
+    tmp+rename, so new checkpoints can no longer be torn; this guards
+    files from other writers and other eras.)"""
+    for epoch in reversed(_checkpoint_epochs(prefix)):
+        try:
+            symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        except Exception as exc:
+            logging.warning(
+                "checkpoint %s-%04d.params is not loadable (%r) — "
+                "falling back to the previous epoch", prefix, epoch, exc)
+            continue
+        return symbol, arg_params, aux_params, epoch
+    return None, None, None, 0
 
 
 def load_checkpoint(prefix, epoch):

@@ -91,7 +91,16 @@ class Module(BaseModule):
         return mod
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        """ref: module.py save_checkpoint."""
+        """ref: module.py save_checkpoint.  graftarmor: in-flight duplex
+        handles (bucket reduces, async weight pulls, queued dist_async
+        pushes) are settled FIRST so the persisted params are
+        step-consistent, and ``nd.save`` underneath publishes atomically
+        (tmp + rename) — a kill mid-save leaves the previous epoch's
+        file intact, never a truncated one."""
+        self._pull_scheduler.finish()
+        drain = getattr(self._kvstore, "_drain_pushes", None)
+        if drain is not None:
+            drain()
         self._symbol.save("%s-symbol.json" % prefix)
         param_name = "%s-%04d.params" % (prefix, epoch)
         self.save_params(param_name)

@@ -121,8 +121,23 @@ def save(fname, data):
         b = n.encode()
         out.append(struct.pack("<Q", len(b)))
         out.append(b)
-    with open(fname, "wb") as f:
-        f.write(b"".join(out))
+    # atomic publish (graftarmor): write-to-tmp + rename, so a crash or
+    # a concurrent reader mid-save can never observe a truncated
+    # .params file — the name either maps to the old bytes or the new
+    import os
+    tmp = "%s.tmp.%d" % (fname, os.getpid())
+    try:
+        with open(tmp, "wb") as f:
+            f.write(b"".join(out))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, fname)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 class _Reader:

@@ -474,6 +474,12 @@ class PullScheduler(object):
     def issue(self, kv, keys, outs, label=None):
         """Put one group's pull on the wire; ``outs`` is a list (per
         key) of out-NDArray lists (one per context replica)."""
+        # graftarmor chaos site: the duplex pull-issue edge (error here
+        # models a wire that dies between step N's update and step N+1's
+        # prefetch — the consumer's abandon-and-fallback rail)
+        from .armor import faults as _faults
+        _faults.fault_point("overlap.pull_issue", n_keys=len(keys),
+                            bucket=label)
         with _tsan.region(self, "issue"):
             return self._issue(kv, keys, outs, label=label)
 

@@ -162,6 +162,8 @@ class DistKVStore(KVStore):
         super().__init__(type_)
         init_process()
         _blackbox.set_rank(rank())      # stamp dumps with this worker
+        from ..armor import faults as _faults
+        _faults.set_rank(rank())        # rank= clause filters (graftarmor)
         self._hb_step = 0               # dist heartbeat step counter
         self._ps_server = None
         self._ps = None
@@ -186,6 +188,20 @@ class DistKVStore(KVStore):
             else:
                 self._ps = ps.GroupClient(ps.lookup_address(idx),
                                           rank=rank())
+        if self._ps is not None:
+            # hand the watchdog a dead-rank source so a trip on a stuck
+            # ps_* bracket can NAME the dead peers (satellite: the trip
+            # dump carries the dead-rank table).  Weakref: the provider
+            # must not keep a closed store alive.
+            import weakref
+            from ..telemetry import watchdog as _watchdog
+            ref = weakref.ref(self)
+            def _dead_ranks():
+                store = ref()
+                if store is None or store._ps is None:
+                    return []
+                return list(store._ps.dead_nodes(window=5.0))
+            _watchdog.register_dead_nodes_provider(_dead_ranks)
 
     # -- dist_async: the host parameter service -----------------------------
     def _async_np(self, nd_value):
@@ -292,7 +308,10 @@ class DistKVStore(KVStore):
         """Drop completed push futures; surface the first failure at the
         next push instead of never.  Done futures are pruned BEFORE the
         raise, so one failed RPC cannot re-raise its stale exception on
-        every later call forever."""
+        every later call forever.  A failure surfacing here is already
+        POST-RETRY: the PSClient wire retried/reconnected through its
+        GRAFT_RPC_RETRIES budget before letting the push task fail, so
+        what lands is a PSUnavailableError, not a transient hiccup."""
         pending, failed = [], None
         for f in self._push_futs:
             if not f.done():

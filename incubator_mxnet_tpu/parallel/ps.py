@@ -39,7 +39,9 @@ stand-in, not a hardened service.
 """
 from __future__ import annotations
 
+import os
 import pickle
+import random
 import socket
 import struct
 import threading
@@ -49,9 +51,41 @@ import zlib
 import numpy as np
 
 __all__ = ["ParameterServer", "PSClient", "ServerGroup", "GroupClient",
-           "publish_address", "lookup_address", "BIGARRAY_BOUND"]
+           "publish_address", "lookup_address", "BIGARRAY_BOUND",
+           "rpc_timeout", "rpc_retries", "rpc_backoff_ms"]
 
 _LEN = struct.Struct("<Q")
+
+
+# -- graftarmor wire policy (docs/robustness.md) ----------------------------
+
+def rpc_timeout():
+    """GRAFT_RPC_TIMEOUT: connect AND per-call socket timeout in seconds
+    (default 60 — the old hardcoded connect timeout, now env-driven)."""
+    try:
+        t = float(os.environ.get("GRAFT_RPC_TIMEOUT", "60"))
+    except ValueError:
+        return 60.0
+    return t if t > 0 else None
+
+
+def rpc_retries():
+    """GRAFT_RPC_RETRIES: retry budget AFTER the first attempt
+    (default 3, so 4 attempts total; 0 restores fail-on-first-error)."""
+    try:
+        return max(0, int(os.environ.get("GRAFT_RPC_RETRIES", "3")))
+    except ValueError:
+        return 3
+
+
+def rpc_backoff_ms():
+    """GRAFT_RPC_BACKOFF_MS: base backoff between retries (default 50).
+    The sleep doubles per attempt, caps at 2s, and is jittered to
+    [0.5x, 1.5x) so a worker fleet never retries in phase."""
+    try:
+        return max(0.0, float(os.environ.get("GRAFT_RPC_BACKOFF_MS", "50")))
+    except ValueError:
+        return 50.0
 
 
 def BIGARRAY_BOUND():
@@ -121,6 +155,11 @@ class ParameterServer(object):
         self._store = {}          # key -> np.ndarray (authoritative)
         self._updater = None      # (key:int, grad, weight) -> None, in place
         self._beats = {}          # worker rank -> last heartbeat time
+        self._dedup = {}          # client id -> highest applied req id
+        #                           (mutating RPCs carry monotonic ids; a
+        #                           client retries strictly in order, so a
+        #                           highwater mark is a complete dedup
+        #                           table — graftarmor idempotence)
         self._lock = threading.Lock()
         if host is None:
             host = _default_bind_host()
@@ -166,12 +205,23 @@ class ParameterServer(object):
 
     def _dispatch(self, conn, msg):
         cmd = msg["cmd"]
+        client, req = msg.get("client"), msg.get("req")
+        if client is not None and req is not None:
+            # a retried mutating RPC after an ambiguous disconnect (the
+            # reply was lost AFTER the server applied it) must not apply
+            # twice — acknowledge and drop anything at or below the
+            # client's applied highwater
+            with self._lock:
+                if req <= self._dedup.get(client, 0):
+                    _send_msg(conn, {"ok": True, "dedup": True})
+                    return
         if cmd == "init":
             with self._lock:
                 # first pushed value defines the key
                 # (kvstore_dist.h Init semantics)
                 for k, v in msg["kv"].items():
                     self._store.setdefault(k, np.array(v))
+                self._mark_locked(client, req)
             _send_msg(conn, {"ok": True})
         elif cmd == "push":
             with self._lock:
@@ -195,6 +245,7 @@ class ParameterServer(object):
                     else:
                         w = self._store[k]
                         w += np.asarray(g).astype(w.dtype)
+                self._mark_locked(client, req)
             _send_msg(conn, {"ok": True})
         elif cmd == "pull":
             with self._lock:
@@ -237,6 +288,7 @@ class ParameterServer(object):
                     from .. import optimizer as opt
                     optimizer = pickle.loads(msg["optimizer"])
                     self._updater = opt.get_updater(optimizer)
+                self._mark_locked(client, req)
             _send_msg(conn, {"ok": True})
         elif cmd == "stop":
             _send_msg(conn, {"ok": True})
@@ -245,6 +297,14 @@ class ParameterServer(object):
             _send_msg(conn, {"ok": False,
                              "error": "unknown cmd %r" % cmd})
 
+
+    def _mark_locked(self, client, req):
+        """Advance one client's applied-request highwater (caller holds
+        ``self._lock``).  The client allocates ids monotonically and
+        retries in submission order, so max() is exact."""
+        if client is not None and req is not None:
+            if req > self._dedup.get(client, 0):
+                self._dedup[client] = req
 
     @staticmethod
     def _int_key(k):
@@ -262,17 +322,108 @@ class ParameterServer(object):
 
 
 class PSClient(object):
-    """One worker's connection to the parameter service."""
+    """One worker's connection to the parameter service.
+
+    Self-healing (graftarmor): every call runs under a per-call socket
+    timeout and a bounded retry loop — timeout/disconnect closes the
+    socket (a late reply on the framed stream would pair with the WRONG
+    request, so the stream is never reused after a timeout), reconnects,
+    backs off exponentially with jitter, and resends.  Mutating commands
+    (push/init/set_optimizer) carry a monotonic ``(client, req)`` id so
+    a retry after an ambiguous disconnect — reply lost AFTER the server
+    applied the mutation — is deduplicated server-side instead of
+    double-applied.  Exhausting the budget raises
+    :class:`~..armor.errors.PSUnavailableError`.
+    """
+
+    # commands whose retry must be idempotent (the dedup table covers
+    # exactly these; reads are naturally safe to repeat)
+    _MUTATING = frozenset(("push", "init", "set_optimizer"))
 
     def __init__(self, address):
         host, port = address.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)), timeout=60)
+        self._addr = (host, int(port))
+        self._client_id = os.urandom(8).hex()
+        self._req_id = 0
+        self._sock = None
+        self._closed = False
         self._lock = threading.Lock()
+        self._connect()          # fail loudly at construction, like before
 
-    def _call(self, msg):
+    def _connect(self):
+        timeout = rpc_timeout()
+        sock = socket.create_connection(self._addr, timeout=timeout)
+        sock.settimeout(timeout)
+        self._sock = sock
+
+    def _drop_sock(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _call(self, msg, retries=None):
+        from ..armor import faults as _faults
+        from ..armor.errors import FaultInjectedError, PSUnavailableError
+        cmd = msg["cmd"]
         with self._lock:
-            _send_msg(self._sock, msg)
-            resp = _recv_msg(self._sock)
+            if self._closed:
+                raise PSUnavailableError(cmd, 0, last_error="client closed")
+            if cmd in self._MUTATING:
+                self._req_id += 1
+                msg = dict(msg, client=self._client_id, req=self._req_id)
+            budget = rpc_retries() if retries is None else int(retries)
+            attempts = budget + 1
+            backoff = rpc_backoff_ms() / 1000.0
+            last = None
+            resp = None
+            for attempt in range(attempts):
+                try:
+                    if self._sock is None:
+                        self._connect()
+                        if attempt > 0:
+                            from ..telemetry import metrics as _tmetrics
+                            _tmetrics.rpc_reconnect()
+                    act = _faults.fault_point("ps.send", cmd=cmd)
+                    if act == "disconnect":
+                        self._drop_sock()
+                        raise ConnectionError("injected disconnect")
+                    if act != "drop":
+                        _send_msg(self._sock, msg)
+                    ract = _faults.fault_point("ps.recv", cmd=cmd)
+                    if ract == "disconnect":
+                        self._drop_sock()
+                        raise ConnectionError("injected disconnect")
+                    if act == "drop" or ract == "drop":
+                        # a swallowed request or reply looks like a
+                        # silent network drop: the reply never comes
+                        raise socket.timeout("injected drop")
+                    resp = _recv_msg(self._sock)
+                    break
+                except (socket.timeout, TimeoutError, ConnectionError,
+                        EOFError, OSError, FaultInjectedError) as exc:
+                    last = exc
+                    self._drop_sock()   # stream desynced: never reuse
+                    if attempt + 1 >= attempts:
+                        from ..telemetry import blackbox as _blackbox
+                        from ..telemetry import metrics as _tmetrics
+                        _tmetrics.rpc_gave_up(cmd)
+                        _blackbox.record("rpc_gave_up", cmd=cmd,
+                                         attempts=attempts,
+                                         error=repr(exc))
+                        raise PSUnavailableError(
+                            cmd, attempts, last_error=exc) from exc
+                    from ..telemetry import blackbox as _blackbox
+                    from ..telemetry import metrics as _tmetrics
+                    _tmetrics.rpc_retry(cmd)
+                    _blackbox.record("rpc_retry", cmd=cmd,
+                                     attempt=attempt + 1,
+                                     error=repr(exc))
+                    sleep = min(backoff * (2 ** attempt), 2.0)
+                    if sleep > 0:
+                        time.sleep(sleep * (0.5 + random.random()))
         if not resp.get("ok"):
             raise RuntimeError("parameter server: %s"
                                % resp.get("error", "unknown failure"))
@@ -300,16 +451,17 @@ class PSClient(object):
                     "optimizer": pickle.dumps(optimizer)})
 
     def heartbeat(self, rank):
-        self._call({"cmd": "heartbeat", "rank": int(rank)})
+        # liveness probes must not mask death by retrying: one attempt
+        self._call({"cmd": "heartbeat", "rank": int(rank)}, retries=0)
 
     def dead_nodes(self, window=5.0):
-        return self._call({"cmd": "dead_nodes", "window": window})["dead"]
+        return self._call({"cmd": "dead_nodes", "window": window},
+                          retries=0)["dead"]
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        with self._lock:
+            self._closed = True      # no teardown-time reconnect storms
+            self._drop_sock()
 
 
 class ServerGroup(object):

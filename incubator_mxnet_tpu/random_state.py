@@ -28,6 +28,41 @@ def seed(seed_state):
     _state.count = 0
 
 
+def get_state():
+    """Snapshot the calling thread's PRNG state as plain host data —
+    what the armor checkpoint serializes so a resumed run draws the same
+    stream the dead one would have.  Handles both key flavors: typed
+    (new-style) keys are unwrapped via ``jax.random.key_data``; raw
+    uint32 keys pass through."""
+    import numpy as np
+    import jax
+    _ensure()
+    k = _state.key
+    typed = False
+    try:
+        typed = jax.dtypes.issubdtype(k.dtype, jax.dtypes.prng_key)
+    except (AttributeError, TypeError):
+        pass
+    raw = np.asarray(jax.random.key_data(k) if typed else k)
+    return {"data": raw.tobytes(), "dtype": str(raw.dtype),
+            "shape": tuple(raw.shape), "typed": typed,
+            "count": _state.count}
+
+
+def set_state(state):
+    """Restore a :func:`get_state` snapshot onto the calling thread."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    raw = np.frombuffer(state["data"], dtype=np.dtype(state["dtype"]))
+    raw = raw.reshape(state["shape"])
+    key = jnp.asarray(raw)
+    if state.get("typed"):
+        key = jax.random.wrap_key_data(key)
+    _state.key = key
+    _state.count = int(state["count"])
+
+
 def next_key():
     import jax
     _ensure()

@@ -33,7 +33,8 @@ a production predictor:
 from __future__ import annotations
 
 from .batcher import (DynamicBatcher, ServeFuture, ServeError,
-                      serve_max_batch, serve_max_wait_ms, parity_mode)
+                      DeadlineExceededError, serve_max_batch,
+                      serve_max_wait_ms, serve_deadline_ms, parity_mode)
 from .registry import (ModelRegistry, ModelHandle, SwapTicket,
                        serve_memory_bytes, serve_batch_mode,
                        default_registry)
@@ -41,8 +42,9 @@ from . import loader
 from . import slo
 
 __all__ = ["Server", "DynamicBatcher", "ServeFuture", "ServeError",
-           "ModelRegistry", "ModelHandle", "SwapTicket", "loader", "slo",
-           "serve_max_batch", "serve_max_wait_ms", "serve_memory_bytes",
+           "DeadlineExceededError", "ModelRegistry", "ModelHandle",
+           "SwapTicket", "loader", "slo", "serve_max_batch",
+           "serve_max_wait_ms", "serve_deadline_ms", "serve_memory_bytes",
            "serve_batch_mode", "parity_mode", "default_registry"]
 
 
@@ -86,9 +88,12 @@ class Server(object):
         return self.registry.begin_swap(name, new_params)
 
     # -- serving -------------------------------------------------------------
-    def submit(self, name, x):
-        """Enqueue one example; returns a :class:`ServeFuture`."""
-        return self.batcher.submit(name, x)
+    def submit(self, name, x, deadline_ms=None):
+        """Enqueue one example; returns a :class:`ServeFuture`.
+        ``deadline_ms`` (default GRAFT_SERVE_DEADLINE_MS) bounds queue
+        time — an expired request is shed with
+        :class:`~.batcher.DeadlineExceededError`."""
+        return self.batcher.submit(name, x, deadline_ms=deadline_ms)
 
     def predict(self, name, x, timeout=30.0):
         """Synchronous convenience: submit + get."""

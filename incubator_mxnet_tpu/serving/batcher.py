@@ -49,10 +49,24 @@ from ..telemetry import metrics as _tmetrics
 from . import slo as _slo
 
 __all__ = ["DynamicBatcher", "ServeFuture", "ServeError",
-           "serve_max_batch", "serve_max_wait_ms", "parity_mode"]
+           "DeadlineExceededError", "serve_max_batch", "serve_max_wait_ms",
+           "serve_deadline_ms", "parity_mode"]
 
 DEFAULT_MAX_BATCH = 32
 DEFAULT_MAX_WAIT_MS = 5.0
+
+
+def serve_deadline_ms():
+    """GRAFT_SERVE_DEADLINE_MS: default per-request deadline (0/unset =
+    none).  A request still queued when its deadline passes is SHED —
+    failed with :class:`DeadlineExceededError` instead of dispatched —
+    so an overloaded server spends device time only on work whose answer
+    somebody still wants (graftarmor load-shedding)."""
+    try:
+        v = float(os.environ.get("GRAFT_SERVE_DEADLINE_MS", "0"))
+    except ValueError:
+        return None
+    return v if v > 0 else None
 
 
 def serve_max_batch():
@@ -89,6 +103,19 @@ def parity_mode():
 
 class ServeError(RuntimeError):
     """A request failed (model error, shutdown, dispatch exception)."""
+
+
+class DeadlineExceededError(ServeError):
+    """The request's ``deadline_ms`` passed while it was still queued —
+    it was shed, never dispatched.  Typed so callers can tell an
+    overload rejection from a model failure and retry elsewhere."""
+
+    def __init__(self, model, waited_ms):
+        super().__init__(
+            "request for model %r shed after %.1fms in queue "
+            "(deadline exceeded)" % (model, waited_ms))
+        self.model = model
+        self.waited_ms = waited_ms
 
 
 def normalize_example(x):
@@ -145,14 +172,18 @@ class ServeFuture(object):
 
 class _Request(object):
     __slots__ = ("model", "xs", "future", "t_enq", "t_pick", "t_built",
-                 "t_computed")
+                 "t_computed", "t_deadline")
 
-    def __init__(self, model, xs):
+    def __init__(self, model, xs, deadline_ms=None):
         self.model = model
         self.xs = xs                # tuple of per-input np arrays
         self.future = ServeFuture()
         self.t_enq = time.perf_counter()
         self.t_pick = self.t_built = self.t_computed = None
+        if deadline_ms is None:
+            deadline_ms = serve_deadline_ms()
+        self.t_deadline = None if deadline_ms is None \
+            else self.t_enq + float(deadline_ms) / 1e3
 
 
 def _bucket_for(n, max_batch):
@@ -194,13 +225,17 @@ class DynamicBatcher(object):
         self.requests_total = 0
 
     # -- submission ----------------------------------------------------------
-    def submit(self, model, x):
+    def submit(self, model, x, deadline_ms=None):
         """Enqueue ONE example for ``model``; returns a
         :class:`ServeFuture`.  ``x`` is a single input (np/NDArray/jax
         array) or a tuple for multi-input models; the model's forward
-        sees it stacked under a leading batch axis."""
+        sees it stacked under a leading batch axis.  ``deadline_ms``
+        (default GRAFT_SERVE_DEADLINE_MS) bounds queue time: a request
+        still undispatched when it expires is shed with
+        :class:`DeadlineExceededError` and counted in
+        ``graft_serve_shed_total``."""
         xs = normalize_example(x)
-        req = _Request(model, xs)
+        req = _Request(model, xs, deadline_ms=deadline_ms)
         key = (model, request_signature(xs))
         with self._cv:
             if self._closed:
@@ -259,13 +294,49 @@ class DynamicBatcher(object):
                 logging.getLogger("graftserve").exception(
                     "dispatch failed outside the batch error path")
 
+    def _shed_locked(self, now):
+        """graftarmor load-shedding: fail every queued request whose
+        deadline passed (typed :class:`DeadlineExceededError`, counted
+        in ``graft_serve_shed_total``) — it was never dispatched, so no
+        device time is burned on an answer nobody is waiting for.
+        Returns the earliest LIVE deadline so the dispatcher's wait
+        wakes in time to shed the next expiry."""
+        earliest = None
+        shed = []
+        for key in list(self._queues):
+            q = self._queues[key]
+            keep = deque()
+            for r in q:
+                if r.t_deadline is not None and now >= r.t_deadline:
+                    shed.append(r)
+                else:
+                    keep.append(r)
+                    if r.t_deadline is not None:
+                        earliest = r.t_deadline if earliest is None \
+                            else min(earliest, r.t_deadline)
+            if len(keep) != len(q):
+                if keep:
+                    self._queues[key] = keep
+                else:
+                    del self._queues[key]
+        if shed:
+            self._depth -= len(shed)
+            for r in shed:
+                waited = (now - r.t_enq) * 1e3
+                r.future._fail(DeadlineExceededError(r.model, waited))
+                _tmetrics.serve_shed(r.model)
+                _blackbox.record("serve_shed", model=r.model,
+                                 waited_ms=round(waited, 3))
+        return earliest
+
     def _pick_locked(self, now, drain=False):
         """Choose the ripest ready queue (full, expired, flushed or
         draining); returns (requests, next_deadline)."""
         with _tsan.region(self, "batcher"):
+            shed_wake = self._shed_locked(now)
             best_key = None
             best_enq = None
-            deadline = None
+            deadline = shed_wake
             for key, q in self._queues.items():
                 if not q:
                     continue
@@ -298,6 +369,11 @@ class DynamicBatcher(object):
         model = reqs[0].model
         bid = next(self._batch_seq)
         try:
+            # graftarmor chaos site: a serving dispatch can be failed or
+            # delayed by GRAFT_FAULTS without touching the model
+            from ..armor import faults as _faults
+            _faults.fault_point("serve.dispatch", model=model,
+                                size=len(reqs))
             entry, params, version = self._registry.acquire(model)
         except Exception as exc:
             self._fail_batch(reqs, exc, model, bid)

@@ -50,7 +50,8 @@ __all__ = ["enabled", "set_enabled", "record", "events", "stats",
            "collective", "phase_begin", "phase_end", "step_journal",
            "workers_seen", "set_rank", "set_clock_offset", "dump",
            "snapshot", "default_path", "validate_dump", "summarize_dump",
-           "install_hooks", "configure", "selftest", "SCHEMA"]
+           "install_hooks", "configure", "selftest", "SCHEMA",
+           "register_emergency", "unregister_emergency"]
 
 SCHEMA = "graft-blackbox/1"
 _DEFAULT_SIZE = 4096
@@ -168,9 +169,12 @@ def last_progress():
 
 
 def _push_inflight(site, detail):
-    entry = {"site": site, "detail": detail, "since": time.time(),
-             "thread": threading.current_thread().name}
     tid = threading.get_ident()
+    # the numeric ident rides the entry so the watchdog's typed
+    # escalation (GRAFT_WATCHDOG_ESCALATE) can raise into the exact
+    # thread that owns the stuck bracket
+    entry = {"site": site, "detail": detail, "since": time.time(),
+             "thread": threading.current_thread().name, "tid": tid}
     with _inflight_lock:
         _inflight.setdefault(tid, []).append(entry)
     return entry
@@ -635,6 +639,25 @@ _hooks_installed = [False]
 _signals_installed = [False]
 _prev_excepthook = None
 _prev_signals = {}
+_emergency_callbacks = []       # run best-effort on SIGTERM/SIGINT BEFORE
+#                                 the dump (graftarmor emergency snapshot)
+
+
+def register_emergency(fn):
+    """Register a callback the signal handler runs (best-effort, before
+    the flight-recorder dump) when the process is being terminated —
+    the armor checkpointer hangs its emergency snapshot here.  Errors
+    are swallowed: a dying process must still dump and exit."""
+    if fn not in _emergency_callbacks:
+        _emergency_callbacks.append(fn)
+    return fn
+
+
+def unregister_emergency(fn):
+    try:
+        _emergency_callbacks.remove(fn)
+    except ValueError:
+        pass
 
 
 def _excepthook(exc_type, exc, tb):
@@ -653,6 +676,11 @@ def _excepthook(exc_type, exc, tb):
 
 
 def _signal_handler(signum, frame):
+    for fn in list(_emergency_callbacks):
+        try:
+            fn(signum)
+        except Exception:
+            pass                # emergency work is best-effort only
     try:
         if enabled() and (_ring or inflight_entries()):
             dump(reason="signal:%d" % signum)

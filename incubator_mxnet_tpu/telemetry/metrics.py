@@ -899,6 +899,95 @@ def serve_parity_fallback(model):
                       "by the parity probe", ("model",)).inc(model=model)
 
 
+# -- graftarmor: fault injection, RPC self-healing, checkpointing -------------
+
+_CKPT_WRITE_BUCKETS = (1e-3, 1e-2, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
+
+
+def fault_injected(site, kind):
+    """One fault fired by the armor injection registry (armor/faults.py)."""
+    if not enabled():
+        return
+    _REGISTRY.counter("graft_faults_injected_total",
+                      "Faults injected by GRAFT_FAULTS, by site and kind",
+                      ("site", "kind")).inc(site=site, kind=kind)
+
+
+def rpc_retry(cmd):
+    """One retried parameter-service RPC attempt (parallel/ps.py)."""
+    if not enabled():
+        return
+    _REGISTRY.counter("graft_rpc_retries_total",
+                      "Parameter-service RPC attempts retried after a "
+                      "transient failure", ("cmd",)).inc(cmd=cmd)
+
+
+def rpc_reconnect():
+    """One PSClient socket rebuild after a disconnect."""
+    if not enabled():
+        return
+    _REGISTRY.counter("graft_rpc_reconnects_total",
+                      "PSClient reconnects after a broken connection").inc()
+
+
+def rpc_gave_up(cmd):
+    """One RPC that exhausted GRAFT_RPC_RETRIES and surfaced a typed
+    PSUnavailableError."""
+    if not enabled():
+        return
+    _REGISTRY.counter("graft_rpc_gave_up_total",
+                      "Parameter-service RPCs that exhausted their retry "
+                      "budget", ("cmd",)).inc(cmd=cmd)
+
+
+def watchdog_escalation(site):
+    """One typed hang exception raised into a waiting thread
+    (GRAFT_WATCHDOG_ESCALATE, telemetry/watchdog.py)."""
+    if not enabled():
+        return
+    _REGISTRY.counter("graft_watchdog_escalations_total",
+                      "Typed hang exceptions escalated into waiting "
+                      "threads", ("site",)).inc(site=site)
+
+
+def checkpoint_saved(seconds, nbytes, step):
+    """One atomic training snapshot written (armor/checkpoint.py)."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    r.counter("graft_checkpoint_saves_total",
+              "Atomic training snapshots written").inc()
+    r.histogram("graft_checkpoint_write_seconds",
+                "Wall time of one snapshot write (drain + serialize + "
+                "rename)", (), buckets=_CKPT_WRITE_BUCKETS).observe(seconds)
+    r.gauge("graft_checkpoint_last_bytes",
+            "Payload bytes of the last snapshot written").set(nbytes)
+    r.gauge("graft_checkpoint_last_step",
+            "Step counter captured by the last snapshot").set(step)
+
+
+def checkpoint_restored(step):
+    """One successful resume() from a snapshot."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    r.counter("graft_checkpoint_restores_total",
+              "Training resumes restored from a snapshot").inc()
+    r.gauge("graft_checkpoint_last_step",
+            "Step counter captured by the last snapshot").set(step)
+
+
+def serve_shed(model, n=1):
+    """Requests shed by the batcher because their deadline expired
+    before dispatch (serving/batcher.py load shedding)."""
+    if not enabled():
+        return
+    _REGISTRY.counter("graft_serve_shed_total",
+                      "Serving requests shed at dispatch because their "
+                      "deadline_ms had already expired", ("model",)).inc(
+        n, model=model)
+
+
 _REGISTRY.register_collector(_collect_device_memory)
 _REGISTRY.register_collector(_collect_autograd_tape)
 _REGISTRY.register_collector(_collect_engine_stats)

@@ -32,7 +32,7 @@ from . import blackbox as _blackbox
 from . import metrics as _metrics
 
 __all__ = ["Watchdog", "start", "stop", "active", "maybe_start",
-           "configured_timeout"]
+           "configured_timeout", "register_dead_nodes_provider"]
 
 _ABORT_EXIT_CODE = 134          # 128 + SIGABRT, the classic watchdog code
 
@@ -52,6 +52,51 @@ def configured_timeout():
 def _abort_configured():
     return os.environ.get("GRAFT_WATCHDOG_ABORT", "").strip().lower() \
         in ("1", "true", "yes", "on")
+
+
+def _escalate_configured():
+    """GRAFT_WATCHDOG_ESCALATE: on trip, raise a typed error INTO the
+    thread blocked on the stuck bracket (graftarmor fail-fast) instead
+    of only dumping.  The raise lands at the next Python bytecode the
+    thread executes — socket waits and lock waits surface it; a thread
+    parked inside a C-level XLA collective does not return to bytecode,
+    so for those GRAFT_WATCHDOG_ABORT remains the only hard stop
+    (docs/robustness.md)."""
+    return os.environ.get("GRAFT_WATCHDOG_ESCALATE", "").strip().lower() \
+        in ("1", "true", "yes", "on")
+
+
+# -- graftarmor: dead-rank attribution --------------------------------------
+
+_dead_provider = [None]
+
+
+def register_dead_nodes_provider(fn):
+    """Install a callable returning the currently-dead worker ranks
+    (DistKVStore registers its PS heartbeat table).  Queried at trip
+    time only, in a sacrificial daemon thread — the provider may need a
+    client lock HELD BY the very RPC that hung, so the watchdog must
+    never call it synchronously."""
+    _dead_provider[0] = fn
+
+
+def _query_dead_ranks(timeout=2.0):
+    fn = _dead_provider[0]
+    if fn is None:
+        return []
+    box = []
+
+    def _run():
+        try:
+            box.append(list(fn()))
+        except Exception:
+            pass
+
+    t = threading.Thread(target=_run, daemon=True,
+                         name="graftwatch-deadnodes")
+    t.start()
+    t.join(timeout)
+    return box[0] if box else []
 
 
 class Watchdog(threading.Thread):
@@ -102,13 +147,16 @@ class Watchdog(threading.Thread):
             self.trip(target, now - target["since"])
 
     def trip(self, entry, age):
-        """Declare the hang: dump, stacks, metrics, (optionally) abort."""
+        """Declare the hang: dump, stacks, metrics, then (optionally)
+        escalate a typed error into the stuck thread and/or abort."""
         self.trips += 1
         detail = entry.get("detail") or {}
+        dead = _query_dead_ranks()
         _blackbox.record("watchdog_trip", site=entry["site"],
                          detail=detail, age_s=round(age, 3),
                          timeout_s=self.timeout,
-                         thread=entry.get("thread"))
+                         thread=entry.get("thread"),
+                         dead_ranks=dead)
         _metrics.watchdog_trip(entry["site"])
         path = _blackbox.dump(
             path=self.path, reason="watchdog", extra={"watchdog": {
@@ -119,19 +167,74 @@ class Watchdog(threading.Thread):
                 "age_s": round(age, 3),
                 "trips": self.trips,
                 "abort": self.abort,
+                "dead_ranks": dead,
             }})
         sys.stderr.write(
             "graftwatch: WATCHDOG TRIP — %r in flight for %.1fs "
-            "(timeout %.1fs), detail=%r; dump: %s\n"
-            % (entry["site"], age, self.timeout, detail, path))
+            "(timeout %.1fs), detail=%r, dead_ranks=%r; dump: %s\n"
+            % (entry["site"], age, self.timeout, detail, dead, path))
         try:
             faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
         except Exception:
             pass
+        if _escalate_configured():
+            self.escalate(entry, age, dead)
         if self.abort:
             sys.stderr.write("graftwatch: GRAFT_WATCHDOG_ABORT set — "
                              "exiting %d\n" % _ABORT_EXIT_CODE)
             os._exit(_ABORT_EXIT_CODE)
+
+    def escalate(self, entry, age, dead_ranks=()):
+        """Raise a typed hang error INTO the thread that opened the
+        stuck bracket (graftarmor fail-fast): a ps_* bracket becomes
+        :class:`~..armor.errors.PSUnavailableError`, any other
+        collective :class:`~..armor.errors.CollectiveTimeoutError`,
+        both naming the dead ranks.  Uses PyThreadState_SetAsyncExc,
+        which instantiates the exception CLASS with no arguments — so
+        the payload rides a dynamically-built zero-arg subclass.  The
+        raise lands only when the target thread next executes Python
+        bytecode (socket/lock waits: yes; C-blocked XLA: no — see
+        GRAFT_WATCHDOG_ABORT).  Returns True if an escalation was
+        delivered."""
+        tid = entry.get("tid")
+        if tid is None or entry.get("site") != "collective":
+            return False
+        from ..armor.errors import (CollectiveTimeoutError,
+                                    PSUnavailableError)
+        detail = entry.get("detail") or {}
+        path = str(detail.get("path", ""))
+        if path.startswith("ps_"):
+            base, args = PSUnavailableError, (
+                path, 0)
+            kwargs = {"last_error": "watchdog trip after %.1fs" % age,
+                      "dead_ranks": tuple(dead_ranks)}
+        else:
+            base, args = CollectiveTimeoutError, (
+                path or entry["site"], round(age, 3), self.timeout)
+            kwargs = {"dead_ranks": tuple(dead_ranks), "detail": detail}
+        exc_cls = type(base.__name__, (base,), {
+            "__init__": (lambda self, _b=base, _a=args, _k=kwargs:
+                         _b.__init__(self, *_a, **_k)),
+            "__module__": base.__module__,
+        })
+        import ctypes
+        delivered = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(tid), ctypes.py_object(exc_cls))
+        if delivered > 1:       # hit more than one thread state: undo
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(tid), None)
+            return False
+        if delivered == 1:
+            _metrics.watchdog_escalation(path or entry["site"])
+            _blackbox.record("watchdog_escalation", site=entry["site"],
+                             path=path, tid=tid, error=base.__name__,
+                             dead_ranks=list(dead_ranks))
+            sys.stderr.write(
+                "graftwatch: escalating %s into thread %d (path=%r, "
+                "dead_ranks=%r)\n"
+                % (base.__name__, tid, path, list(dead_ranks)))
+            return True
+        return False
 
 
 _active = [None]

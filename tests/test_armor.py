@@ -1,0 +1,475 @@
+"""graftarmor: fault injection, self-healing PS wire, atomic
+checkpoint/auto-resume, typed hang escalation (PR 15).
+
+Single-process coverage of the robustness layer; the 2-process chaos
+parity and kill-rank gates live in test_dist_multiprocess.py.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.armor import (CheckpointCorruptError,
+                                       CollectiveTimeoutError,
+                                       FaultInjectedError,
+                                       PSUnavailableError, faults)
+from incubator_mxnet_tpu.armor import checkpoint as ckpt
+
+_ENV = ("GRAFT_FAULTS", "GRAFT_RPC_TIMEOUT", "GRAFT_RPC_RETRIES",
+        "GRAFT_RPC_BACKOFF_MS", "GRAFT_WATCHDOG_ESCALATE",
+        "GRAFT_SERVE_DEADLINE_MS")
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    saved = {k: os.environ.get(k) for k in _ENV}
+    yield
+    faults.reset()
+    faults.set_rank(None)
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _fires(spec, site, n, **ctx):
+    faults.configure(spec)
+    out = []
+    for _ in range(n):
+        try:
+            faults.fault_point(site, **ctx)
+            out.append(False)
+        except FaultInjectedError:
+            out.append(True)
+    return out
+
+
+# -- fault grammar -----------------------------------------------------------
+
+def test_fault_grammar_selectors():
+    assert _fires("a.b:error:n=3", "a.b", 5) \
+        == [False, False, True, False, False]
+    assert _fires("a.*:error:every=2:times=2", "a.x", 8) \
+        == [False, True, False, True, False, False, False, False]
+    assert _fires("a.b:error", "a.b", 3) == [True] * 3  # bare: every arrival
+    assert _fires("a.b:error:cmd=push", "a.b", 2, cmd="pull") == [False] * 2
+    assert _fires("a.b:error:cmd=push", "a.b", 2, cmd="push") == [True] * 2
+
+
+def test_fault_grammar_seeded_probability_replays():
+    one = _fires("p.q:error:p=0.4:seed=11:times=100", "p.q", 30)
+    two = _fires("p.q:error:p=0.4:seed=11:times=100", "p.q", 30)
+    assert one == two and any(one) and not all(one)
+
+
+def test_fault_grammar_rank_filter():
+    faults.set_rank(1)
+    assert _fires("r.s:error:rank=0", "r.s", 2) == [False, False]
+    faults.set_rank(0)
+    assert _fires("r.s:error:rank=0:n=1", "r.s", 2) == [True, False]
+
+
+def test_fault_grammar_rejects_bad_specs():
+    for bad in ("siteonly", "a.b:melt", "a.b:error:n"):
+        with pytest.raises(ValueError):
+            faults.configure(bad)
+
+
+def test_faults_off_by_default_inert():
+    faults.reset()
+    assert faults.fault_point("anything", cmd="push") is None
+    assert faults.active_rules() == []
+
+
+# -- self-healing PS wire ----------------------------------------------------
+
+@pytest.fixture()
+def ps_pair():
+    from incubator_mxnet_tpu.parallel import ps
+    os.environ["GRAFT_RPC_TIMEOUT"] = "10"
+    os.environ["GRAFT_RPC_RETRIES"] = "2"
+    os.environ["GRAFT_RPC_BACKOFF_MS"] = "1"
+    srv = ps.ParameterServer(host="127.0.0.1")
+    client = ps.PSClient(srv.address)
+    yield srv, client
+    faults.reset()
+    client.close()
+    srv.shutdown()
+
+
+def test_ps_retry_after_dropped_reply_is_idempotent(ps_pair):
+    _, client = ps_pair
+    client.init({"w": np.zeros(4, np.float32)})
+    # the reply to an APPLIED push is dropped: the retried request must
+    # be deduplicated server-side (same monotonic id), not applied twice
+    faults.configure("ps.recv:drop:n=1:cmd=push")
+    client.push({"w": np.ones(4, np.float32)})
+    assert float(client.pull(["w"])["w"][0]) == 1.0
+
+
+def test_ps_reconnects_across_injected_disconnect(ps_pair):
+    _, client = ps_pair
+    client.init({"w": np.zeros(4, np.float32)})
+    faults.configure("ps.send:disconnect:n=1:cmd=push")
+    client.push({"w": np.ones(4, np.float32)})
+    assert float(client.pull(["w"])["w"][0]) == 1.0
+
+
+def test_ps_gives_up_with_typed_error(ps_pair):
+    _, client = ps_pair
+    client.init({"w": np.zeros(4, np.float32)})
+    faults.configure("ps.send:error:every=1:cmd=push")
+    with pytest.raises(PSUnavailableError) as ei:
+        client.push({"w": np.ones(4, np.float32)})
+    assert ei.value.cmd == "push"
+    assert ei.value.attempts == 3          # 1 try + GRAFT_RPC_RETRIES=2
+    faults.reset()
+    # the wire heals once the chaos stops
+    client.push({"w": np.ones(4, np.float32)})
+    assert float(client.pull(["w"])["w"][0]) == 1.0
+
+
+def test_ps_closed_client_fails_fast(ps_pair):
+    _, client = ps_pair
+    client.init({"w": np.zeros(4, np.float32)})
+    client.close()
+    with pytest.raises(PSUnavailableError):
+        client.push({"w": np.ones(4, np.float32)})
+
+
+# -- atomic checkpoint -------------------------------------------------------
+
+def test_save_state_roundtrip_and_manifest(tmp_path):
+    path = str(tmp_path / "snap.armor")
+    state = {"step": 7, "params": {"w": np.arange(6, dtype=np.float32)}}
+    ckpt.save_state(path, state)
+    man = ckpt.manifest_of(path)
+    assert man["format"] == ckpt.FORMAT and man["step"] == 7
+    got = ckpt.load_state(path)
+    assert got["step"] == 7
+    assert np.array_equal(got["params"]["w"], state["params"]["w"])
+    assert not [p for p in os.listdir(str(tmp_path)) if ".tmp" in p]
+
+
+def test_load_state_rejects_every_corruption(tmp_path):
+    path = str(tmp_path / "snap.armor")
+    ckpt.save_state(path, {"step": 1})
+    raw = open(path, "rb").read()
+    cases = {
+        "flipped payload byte": raw[:-2] + bytes([raw[-2] ^ 0xFF]) + raw[-1:],
+        "truncated": raw[: len(raw) // 2],
+        "bad magic": b"NOPE" + raw[4:],
+        "empty": b"",
+    }
+    for name, blob in cases.items():
+        bad = str(tmp_path / ("bad-" + name.split()[0]))
+        with open(bad, "wb") as f:
+            f.write(blob)
+        with pytest.raises(CheckpointCorruptError):
+            ckpt.load_state(bad)
+    with pytest.raises(CheckpointCorruptError):
+        ckpt.load_state(str(tmp_path / "does-not-exist.armor"))
+
+
+def _tiny_trainer(seed=5):
+    from incubator_mxnet_tpu import gluon
+    net = gluon.nn.Dense(3)
+    net.initialize(ctx=mx.cpu())
+    rs = np.random.RandomState(seed)
+    net(nd.array(rs.randn(2, 4).astype(np.float32)))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    return net, trainer, rs
+
+
+def _train_step(net, trainer, rs):
+    from incubator_mxnet_tpu import autograd
+    x = nd.array(rs.randn(2, 4).astype(np.float32))
+    with autograd.record():
+        loss = (net(x) * net(x)).sum()
+    loss.backward()
+    trainer.step(2)
+    return float(loss.asnumpy())
+
+
+def _param_bytes(net):
+    return {name: p.data().asnumpy().tobytes()
+            for name, p in net.collect_params().items()}
+
+
+def test_checkpointer_resumes_last_valid_snapshot(tmp_path):
+    net, trainer, rs = _tiny_trainer()
+    _train_step(net, trainer, rs)
+    cp = trainer.checkpointer(str(tmp_path), keep=4, emergency=False)
+    try:
+        cp.save(step=1)
+        want = _param_bytes(net)
+        _train_step(net, trainer, rs)
+        cp.save(step=2)
+        # corrupt the newest snapshot: resume must fall back to step 1
+        p2 = cp._path(2)
+        blob = bytearray(open(p2, "rb").read())
+        blob[-1] ^= 0xFF
+        with open(p2, "wb") as f:
+            f.write(blob)
+        assert cp.latest_valid()[0] == 1
+        assert cp.resume() == 1
+        assert _param_bytes(net) == want
+        # momentum restored: a step off the restored state replays
+        # bit-exactly
+        rs2 = np.random.RandomState(99)
+        first = _train_step(net, trainer, rs2)
+        after = _param_bytes(net)
+        cp.resume()
+        rs2 = np.random.RandomState(99)
+        assert _train_step(net, trainer, rs2) == first
+        assert _param_bytes(net) == after
+    finally:
+        cp.close()
+
+
+def test_checkpointer_periodic_and_prune(tmp_path):
+    net, trainer, rs = _tiny_trainer()
+    os.environ["GRAFT_CHECKPOINT_EVERY"] = "2"
+    try:
+        cp = trainer.checkpointer(str(tmp_path), keep=2, emergency=False)
+        try:
+            for step in range(1, 7):
+                _train_step(net, trainer, rs)
+                cp.step_end(step)
+            snaps = sorted(f for f in os.listdir(str(tmp_path))
+                           if f.endswith(".armor"))
+            assert snaps == ["ckpt-00000004.armor", "ckpt-00000006.armor"]
+        finally:
+            cp.close()
+    finally:
+        os.environ.pop("GRAFT_CHECKPOINT_EVERY", None)
+
+
+def test_trainer_save_load_checkpoint_roundtrip(tmp_path):
+    net, trainer, rs = _tiny_trainer()
+    _train_step(net, trainer, rs)
+    path = str(tmp_path / "one.armor")
+    trainer.save_checkpoint(path, step=5)
+    want = _param_bytes(net)
+    _train_step(net, trainer, rs)
+    assert _param_bytes(net) != want
+    assert trainer.load_checkpoint(path) == 5
+    assert _param_bytes(net) == want
+
+
+def test_fast_forward_data_iter():
+    it = iter(range(10))
+    ckpt.fast_forward(it, 4)
+    assert next(it) == 4
+
+
+# -- model.py checkpoint edges (satellite 4) ---------------------------------
+
+def _write_model_ckpts(tmp_path, epochs):
+    import incubator_mxnet_tpu.model as model
+    prefix = str(tmp_path / "net")
+    sym = mx.sym.Variable("data")
+    for ep in epochs:
+        model.save_checkpoint(prefix, ep, sym,
+                              {"w": nd.ones((2, 2)) * ep}, {})
+    return prefix
+
+
+def test_resume_from_checkpoint_skips_corrupt_newest(tmp_path):
+    import incubator_mxnet_tpu.model as model
+    prefix = _write_model_ckpts(tmp_path, [1, 2, 3])
+    with open("%s-0003.params" % prefix, "wb") as f:
+        f.write(b"garbage that is not a params file")
+    _sym, arg, _aux, epoch = model.resume_from_checkpoint(prefix)
+    assert epoch == 2
+    assert np.allclose(arg["w"].asnumpy(), 2.0)
+
+
+def test_resume_from_checkpoint_skips_truncated(tmp_path):
+    import incubator_mxnet_tpu.model as model
+    prefix = _write_model_ckpts(tmp_path, [1, 2])
+    p = "%s-0002.params" % prefix
+    blob = open(p, "rb").read()
+    with open(p, "wb") as f:
+        f.write(blob[: max(len(blob) // 3, 1)])
+    _sym, arg, _aux, epoch = model.resume_from_checkpoint(prefix)
+    assert epoch == 1
+    assert np.allclose(arg["w"].asnumpy(), 1.0)
+
+
+def test_resume_from_checkpoint_tolerates_missing_epoch(tmp_path):
+    import incubator_mxnet_tpu.model as model
+    prefix = _write_model_ckpts(tmp_path, [1, 5])     # gap: 2-4 missing
+    assert model.latest_checkpoint(prefix) == 5
+    _sym, arg, _aux, epoch = model.resume_from_checkpoint(prefix)
+    assert epoch == 5
+    assert np.allclose(arg["w"].asnumpy(), 5.0)
+
+
+def test_resume_from_checkpoint_none_valid(tmp_path):
+    import incubator_mxnet_tpu.model as model
+    prefix = str(tmp_path / "net")
+    assert model.resume_from_checkpoint(prefix) == (None, None, None, 0)
+    with open("%s-0001.params" % prefix, "wb") as f:
+        f.write(b"junk")
+    sym = mx.sym.Variable("data")
+    sym.save("%s-symbol.json" % prefix)
+    assert model.resume_from_checkpoint(prefix)[3] == 0
+
+
+def test_nd_save_is_atomic(tmp_path):
+    # tmp-then-rename: a leftover .tmp from a crashed writer is ignored
+    # by the epoch scan, and a completed save leaves no tmp behind
+    path = str(tmp_path / "x.params")
+    nd.save(path, {"w": nd.ones((3,))})
+    assert [f for f in os.listdir(str(tmp_path)) if ".tmp" in f] == []
+    assert np.allclose(nd.load(path)["w"].asnumpy(), 1.0)
+
+    import incubator_mxnet_tpu.model as model
+    prefix = _write_model_ckpts(tmp_path, [1])
+    with open("%s-0002.params.tmp.12345" % prefix, "wb") as f:
+        f.write(b"half-written")
+    assert model.latest_checkpoint(prefix) == 1
+
+
+# -- serving deadline shed (satellite 3) -------------------------------------
+
+def test_serving_sheds_expired_requests():
+    from incubator_mxnet_tpu import serving
+    b = serving.DynamicBatcher(serving.ModelRegistry(),
+                               max_batch=64, max_wait_ms=10000)
+    try:
+        fut = b.submit("m", np.zeros(3, np.float32), deadline_ms=20)
+        with pytest.raises(serving.DeadlineExceededError) as ei:
+            fut.get(timeout=10.0)
+        assert ei.value.model == "m"
+        assert ei.value.waited_ms >= 20.0
+    finally:
+        b.close()
+
+
+def test_serving_deadline_env_default():
+    from incubator_mxnet_tpu import serving
+    os.environ["GRAFT_SERVE_DEADLINE_MS"] = "15"
+    try:
+        assert serving.serve_deadline_ms() == 15.0
+        b = serving.DynamicBatcher(serving.ModelRegistry(),
+                                   max_batch=64, max_wait_ms=10000)
+        try:
+            fut = b.submit("m", np.zeros(3, np.float32))
+            with pytest.raises(serving.DeadlineExceededError):
+                fut.get(timeout=10.0)
+        finally:
+            b.close()
+    finally:
+        os.environ.pop("GRAFT_SERVE_DEADLINE_MS", None)
+    assert serving.serve_deadline_ms() is None      # off by default
+
+
+def test_serving_dispatch_fault_fails_batch_not_server():
+    from incubator_mxnet_tpu import serving
+    from incubator_mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(2)
+    net.initialize(ctx=mx.cpu())
+    net(nd.ones((1, 3)))
+    with serving.Server(max_batch=4, max_wait_ms=1) as srv:
+        srv.load("m", block=net, example=nd.ones((1, 3)))
+        x = np.ones(3, np.float32)
+        want = srv.submit("m", x).get(timeout=60.0)
+        faults.configure("serve.dispatch:error:n=1")
+        fut = srv.submit("m", x)
+        with pytest.raises(FaultInjectedError):
+            fut.get(timeout=60.0)
+        faults.reset()
+        # the dispatcher survives the injected dispatch failure
+        again = srv.submit("m", x).get(timeout=60.0)
+        assert np.allclose(np.asarray(again), np.asarray(want))
+
+
+# -- typed hang escalation ---------------------------------------------------
+
+def test_watchdog_escalation_delivers_typed_error(tmp_path):
+    from incubator_mxnet_tpu.telemetry import blackbox, watchdog
+
+    os.environ["GRAFT_WATCHDOG_ESCALATE"] = "1"
+    prev = blackbox._enabled_override
+    blackbox.set_enabled(True)
+    watchdog.register_dead_nodes_provider(lambda: [2])
+    caught = []
+    ready = threading.Event()
+
+    def victim():
+        try:
+            with blackbox.collective("ps_push", n_keys=1):
+                ready.set()
+                for _ in range(400):
+                    time.sleep(0.01)
+        except PSUnavailableError as exc:
+            caught.append(exc)
+
+    timeout = 0.4
+    t = threading.Thread(target=victim, daemon=True)
+    path = str(tmp_path / "trip.json")
+    wd = watchdog.Watchdog(timeout=timeout, path=path)
+    try:
+        t.start()
+        assert ready.wait(5.0)
+        t0 = time.perf_counter()
+        wd.start()
+        t.join(10.0)
+        elapsed = time.perf_counter() - t0
+        assert caught, "typed error never reached the waiting thread"
+        assert caught[0].dead_ranks == (2,)
+        # the fail-fast budget: trip within ~1.25x timeout, delivery on
+        # the victim's next bytecode hop (10ms sleep slices) + slack
+        assert elapsed < 1.25 * timeout + 1.0, elapsed
+        import json
+        doc = json.load(open(path))
+        assert blackbox.validate_dump(doc) == []
+        assert doc["watchdog"]["dead_ranks"] == [2]
+    finally:
+        wd.stop()
+        watchdog.register_dead_nodes_provider(None)
+        blackbox.set_enabled(prev)
+
+
+def test_escalation_off_by_default(tmp_path):
+    from incubator_mxnet_tpu.telemetry import blackbox, watchdog
+
+    os.environ.pop("GRAFT_WATCHDOG_ESCALATE", None)
+    prev = blackbox._enabled_override
+    blackbox.set_enabled(True)
+    done = threading.Event()
+    survived = []
+
+    def victim():
+        with blackbox.collective("ps_push", n_keys=1):
+            done.wait(3.0)
+        survived.append(True)
+
+    t = threading.Thread(target=victim, daemon=True)
+    wd = watchdog.Watchdog(timeout=0.2, path=str(tmp_path / "t.json"))
+    try:
+        t.start()
+        time.sleep(0.5)
+        wd.poll()               # trips, dumps — but must NOT escalate
+        done.set()
+        t.join(5.0)
+        assert survived == [True]
+    finally:
+        blackbox.set_enabled(prev)
+
+
+def test_typed_errors_carry_payload():
+    e = CollectiveTimeoutError("collective", 1.5, 1.0, dead_ranks=(4,),
+                               detail={"path": "reduce"})
+    assert e.dead_ranks == (4,) and e.timeout_s == 1.0
+    p = PSUnavailableError("push", 3, last_error="boom", dead_ranks=(1,))
+    assert p.cmd == "push" and p.attempts == 3 and p.dead_ranks == (1,)

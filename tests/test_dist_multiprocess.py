@@ -334,6 +334,143 @@ def test_async_dead_node_detection(tmp_path):
     assert "WORKER 1 DYING" in out, out[-3000:]
 
 
+_CHAOS_WORKER_TMPL = _skipwrap("""
+    import hashlib
+    os.environ["GRAFT_RPC_BACKOFF_MS"] = "1"
+    os.environ["GRAFT_FAULTS"] = "@FAULTS@"
+    kva = mx.kv.create("dist_async")
+    rank = kva.rank
+    import incubator_mxnet_tpu.optimizer as opt
+    kva.init("w", nd.ones((8,)) * 64.0)
+    kva.set_optimizer(opt.create("sgd", learning_rate=1.0))
+    # exact integer algebra: each push applies w -= grad server-side, so
+    # ANY interleave/retry schedule that applies each push EXACTLY ONCE
+    # lands on 64 - 5*(1+2) = 49 bit-for-bit.  A dropped-reply retry
+    # that double-applied would land on != 49 and break the parity hash.
+    for step in range(5):
+        kva.push("w", nd.ones((8,)) * (rank + 1))
+        kva.barrier()
+    out = nd.zeros((8,))
+    kva.pull("w", out=out)
+    arr = np.asarray(out.asnumpy(), np.float32)
+    assert np.allclose(arr, 49.0), arr
+    from incubator_mxnet_tpu.telemetry import blackbox
+    n_inj = len([e for e in blackbox.events()
+                 if e["kind"] == "fault_injected"])
+    print("CHAOS %d SHA %s INJ %d"
+          % (rank, hashlib.sha256(arr.tobytes()).hexdigest(), n_inj),
+          flush=True)
+    kva.barrier()
+""")
+
+
+def _chaos_shas(out):
+    shas, inj = {}, {}
+    for line in out.splitlines():
+        if line.startswith("CHAOS "):
+            parts = line.split()
+            shas[int(parts[1])] = parts[3]
+            inj[int(parts[1])] = int(parts[5])
+    return shas, inj
+
+
+def test_two_process_chaos_parity(tmp_path):
+    """graftarmor chaos gate: the same dist_async run under injected PS
+    wire faults (dropped replies, mid-push disconnects on both ranks)
+    must be BYTE-EQUAL to the un-faulted run — retries are idempotent
+    (server-side dedup), reconnects are transparent."""
+    clean = _launch_two(tmp_path,
+                        _CHAOS_WORKER_TMPL.replace("@FAULTS@", ""),
+                        timeout=240, port_base=10300)
+    shas0, inj0 = _chaos_shas(clean)
+    assert set(shas0) == {0, 1}, clean[-2000:]
+    assert inj0 == {0: 0, 1: 0}, inj0
+
+    spec = ("ps.recv:drop:n=2:cmd=push:rank=0;"
+            "ps.send:disconnect:n=3:cmd=push:rank=1;"
+            "ps.recv:drop:n=4:cmd=push:rank=1")
+    chaos = _launch_two(tmp_path,
+                        _CHAOS_WORKER_TMPL.replace("@FAULTS@", spec),
+                        timeout=240, port_base=10300)
+    shas1, inj1 = _chaos_shas(chaos)
+    assert set(shas1) == {0, 1}, chaos[-2000:]
+    assert inj1[0] >= 1 and inj1[1] >= 2, inj1   # the chaos really fired
+    assert shas1 == shas0, (shas0, shas1)        # ...and changed nothing
+
+
+_KILL_RESUME_WORKER = _skipwrap("""
+    import time
+    os.environ["GRAFT_RPC_BACKOFF_MS"] = "1"
+    # rank 1 is killed mid-push (injected SIGKILL-style os._exit) — the
+    # kill-rank-mid-step harness; rank 0 must see the dead rank AND its
+    # own checkpoint/resume must replay the loss trajectory bit-exactly
+    os.environ["GRAFT_FAULTS"] = "ps.send:kill:n=3:rank=1"
+    kv = mx.kv.create("dist_async")
+    rank = kv.rank
+    kv.init("w", nd.ones((4,)))
+    kv.barrier()
+    if rank == 1:
+        print("WORKER 1 PUSHING UNTIL KILLED", flush=True)
+        for _ in range(10):
+            kv.push("w", nd.ones((4,)))     # 3rd send never returns
+        raise AssertionError("injected kill did not fire")
+
+    from incubator_mxnet_tpu import gluon, autograd
+    net = gluon.nn.Dense(4)
+    net.initialize(ctx=mx.cpu())
+    rng = np.random.RandomState(7)
+    batches = [rng.randn(2, 6).astype(np.float32) for _ in range(7)]
+    net(nd.array(batches[0]))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+
+    def step(i):
+        x = nd.array(batches[i])
+        with autograd.record():
+            loss = (net(x) * net(x)).sum()
+        loss.backward()
+        trainer.step(2)
+        return float(loss.asnumpy())
+
+    ckdir = os.path.join(os.getcwd(), "graft-ckpt-%d" % os.getpid())
+    cp = trainer.checkpointer(ckdir, keep=3, emergency=False)
+    first = []
+    for i in range(6):
+        first.append(step(i))
+        if i == 2:
+            cp.save(step=2)
+    restored = cp.resume()
+    assert restored == 2, restored
+    replay = [step(i) for i in range(3, 6)]
+    assert replay == first[3:], (replay, first[3:])   # bit-exact losses
+    print("WORKER 0 RESUME OK", flush=True)
+
+    deadline = time.time() + 30
+    n = 0
+    while time.time() < deadline:
+        n = kv.num_dead_nodes(timeout_sec=2)
+        if n == 1:
+            break
+        time.sleep(0.5)
+    assert n == 1, n
+    print("WORKER 0 KILLRESUME OK", flush=True)
+    os._exit(0)   # skip jax.distributed teardown: rank 1 is gone
+""")
+
+
+def test_kill_rank_checkpoint_resume(tmp_path):
+    """graftarmor fail-recover gate: rank 1 dies mid-push via the
+    injected kill harness; rank 0's heartbeat table flips the dead rank
+    and its checkpoint resume() replays the loss trajectory bit-exactly
+    (params + momentum + RNG restored)."""
+    out = _launch_two(tmp_path, _KILL_RESUME_WORKER, timeout=240,
+                      port_base=10600, require_rc0=False)
+    assert "WORKER 1 PUSHING UNTIL KILLED" in out, out[-3000:]
+    assert "graftarmor: injected kill" in out, out[-3000:]
+    assert "WORKER 0 RESUME OK" in out, out[-3000:]
+    assert "WORKER 0 KILLRESUME OK" in out, out[-3000:]
+
+
 def test_num_dead_nodes_surfaces_gauge_single_process():
     """Single-process contract of the same surfacing: the sync wire
     always answers 0, and the answer lands on the gauge (runnable

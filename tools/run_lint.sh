@@ -40,7 +40,15 @@
 #    bench_serving.py --smoke emits the serving BENCH JSON (p50/p99 vs
 #    offered QPS) asserting batched dispatch >= 3x the serial
 #    Module.predict loop with bit-equal outputs.
-# 8. graftpulse smoke — telemetry.autotune --selftest runs the synthetic
+# 8. graftarmor smoke — armor --selftest exercises the robustness layer
+#    end-to-end: deterministic fault-grammar replay, PS wire self-healing
+#    against a real ParameterServer (retry + idempotent server-side dedup
+#    + typed give-up), atomic checkpoint round-trip with last-valid
+#    resume after corruption, and watchdog hang escalation delivering a
+#    typed error naming the dead rank; bench_eager --smoke (tier 3)
+#    additionally reports armor_overhead_pct (retry plumbing with zero
+#    faults armed) against its < 2% budget in BENCH JSON.
+# 9. graftpulse smoke — telemetry.autotune --selftest runs the synthetic
 #    starved-DataLoader scenario end-to-end: the lens-driven controller
 #    must grow the loader's workers until the data_wait fraction drops
 #    below the bound within a bounded number of steps, with every
@@ -68,6 +76,9 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m incubator_mxnet_tpu.serving --selftest \
     || exit $?
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench_serving.py --smoke \
+    || exit $?
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m incubator_mxnet_tpu.armor --selftest \
     || exit $?
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m incubator_mxnet_tpu.telemetry.autotune --selftest \
