@@ -106,6 +106,83 @@ def _tpu_kernel_smoke(backend):
             % (causal, err)
 
 
+def _compiled_step_probe(n_params=8, shape=(16, 16), iters=6):
+    """graftstep rider: a token-sized whole-step-compilation probe so the
+    chip bench's JSON carries the compiled-vs-eager step ratio on the
+    REAL backend (the full 64-param gate lives in bench_eager --smoke).
+    Returns {} when the probe cannot run — the headline img/s must not
+    die on a telemetry rider."""
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon
+    try:
+        class Net(gluon.HybridBlock):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                with self.name_scope():
+                    for k in range(n_params):
+                        setattr(self, "w%d" % k,
+                                self.params.get("w%d" % k, shape=shape))
+
+            def hybrid_forward(self, F, x, **ps):
+                acc = None
+                for k in range(n_params):
+                    y = (ps["w%d" % k] * ps["w%d" % k] * x).sum()
+                    acc = y if acc is None else acc + y
+                return acc
+
+        def build(prefix):
+            net = Net(prefix=prefix)
+            net.initialize(ctx=mx.cpu())
+            rs = np.random.RandomState(0)
+            for name in sorted(net.collect_params()):
+                p = net.collect_params()[name]
+                p.set_data(mx.nd.array(
+                    rs.randn(*p.shape).astype(np.float32)))
+            tr = gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.01, "momentum": 0.9},
+                               kvstore=None)
+            return net, tr
+
+        x = mx.nd.array(
+            np.random.RandomState(1).rand(*shape).astype(np.float32))
+        net_e, tr_e = build("bpe")
+        net_c, tr_c = build("bpc")
+        cstep = tr_c.compile_step(net_c, enabled=True)
+
+        def eager_iter():
+            with autograd.record():
+                out = net_e(x)
+            out.backward()
+            tr_e.step(1)
+
+        for _ in range(2):          # warm: compiles + lazy trace
+            eager_iter()
+            cstep(x)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            eager_iter()
+        net_e.collect_params()[sorted(net_e.collect_params())[-1]] \
+            .data().asnumpy()
+        dt_e = (time.perf_counter() - t0) / iters
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            cstep(x)
+        net_c.collect_params()[sorted(net_c.collect_params())[-1]] \
+            .data().asnumpy()
+        dt_c = (time.perf_counter() - t0) / iters
+        return {
+            "compiled_step_latency_ratio": round(dt_c / dt_e, 3),
+            "compiled_step_eager_ms": round(dt_e * 1e3, 3),
+            "compiled_step_compiled_ms": round(dt_c * 1e3, 3),
+            "compiled_step_backend": jax.default_backend(),
+            "compiled_step_retraces": cstep.retraces,
+        }
+    except Exception as exc:
+        return {"compiled_step_error": "%s: %s" % (type(exc).__name__,
+                                                   exc)}
+
+
 def main():
     backend = _resolve_backend()
     import jax
@@ -186,6 +263,7 @@ def main():
             "backend": backend,
             "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
             "host_pipeline_img_per_sec": round(pipe_img_s, 2),
+            **_compiled_step_probe(),
             "metrics": mx.telemetry.compact_snapshot(),
             "blackbox": mx.telemetry.blackbox.stats(),
         }))
@@ -214,6 +292,7 @@ def main():
         "unit": "img/s",
         "backend": backend,
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        **_compiled_step_probe(),
         "metrics": mx.telemetry.compact_snapshot(),
         "blackbox": mx.telemetry.blackbox.stats(),
     }))

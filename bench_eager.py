@@ -305,6 +305,120 @@ def _duplex_step_bench(iters=12, repeats=3, n_params=FUSED_N_PARAMS,
     }
 
 
+def _compiled_step_bench(iters=12, repeats=3, n_params=FUSED_N_PARAMS,
+                         shape=FUSED_SHAPE, ulp_tol=16):
+    """graftstep: the whole bucketed-eager training iteration
+    (record → forward → backward → Trainer.step, dispatched as many
+    programs plus the host tape walk) vs the SAME iteration as the
+    compiled whole-step program pair (fwd+bwd → ``reduce_many`` →
+    donated fused update) over the 64-param dist_sync model the other
+    trainer benches use.  The whole iteration is timed — the compiled
+    step's claim is that the HOST work between programs (eager op
+    dispatch, tape bookkeeping, 64 per-param python hops) disappears,
+    not that any one program gets faster.  Params+states parity is
+    asserted under the documented ULP tolerance (lr rides as a traced
+    operand in the compiled program — ~1 ULP fma drift per step), and
+    the static-shape loop must show exactly ONE trace (zero retraces
+    after step 2)."""
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon
+    from incubator_mxnet_tpu.gluon.step_compile import max_ulp_diff
+
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                for k in range(n_params):
+                    setattr(self, "w%d" % k,
+                            self.params.get("w%d" % k, shape=shape))
+
+        def hybrid_forward(self, F, x, **ps):
+            acc = None
+            for k in range(n_params):
+                y = (ps["w%d" % k] * ps["w%d" % k] * x).sum()
+                acc = y if acc is None else acc + y
+            return acc
+
+    def build(prefix):
+        net = Net(prefix=prefix)
+        net.initialize(ctx=mx.cpu())
+        rs = np.random.RandomState(0)
+        for name in sorted(net.collect_params()):
+            p = net.collect_params()[name]
+            p.set_data(mx.nd.array(
+                rs.randn(*p.shape).astype(np.float32)))
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.01, "momentum": 0.9},
+                           kvstore=mx.kv.create("dist_sync"))
+        return net, tr
+
+    x = mx.nd.array(
+        np.random.RandomState(1).rand(*shape).astype(np.float32))
+    net_e, tr_e = build("cse")
+    net_c, tr_c = build("csc")
+    cstep = tr_c.compile_step(net_c, enabled=True)
+
+    def eager_iter():
+        with autograd.record():
+            out = net_e(x)
+        out.backward()
+        tr_e.step(1)
+
+    def compiled_iter():
+        cstep(x, batch_size=1)
+
+    # warmup: the eager arm compiles its per-op/per-bucket programs and
+    # builds its plan; the compiled arm's first call falls back eager
+    # and traces lazily, the second dispatches the compiled pair
+    for _ in range(2):
+        eager_iter()
+        compiled_iter()
+    net_e.collect_params()[sorted(net_e.collect_params())[0]] \
+        .data().asnumpy()
+    best = {"eager": float("inf"), "compiled": float("inf")}
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            eager_iter()
+        net_e.collect_params()[sorted(net_e.collect_params())[-1]] \
+            .data().asnumpy()                    # sync
+        best["eager"] = min(best["eager"],
+                            (time.perf_counter() - t0) / iters)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            compiled_iter()
+        net_c.collect_params()[sorted(net_c.collect_params())[-1]] \
+            .data().asnumpy()
+        best["compiled"] = min(best["compiled"],
+                               (time.perf_counter() - t0) / iters)
+    worst_ulp = 0
+    for ne, nc in zip(sorted(net_e.collect_params()),
+                      sorted(net_c.collect_params())):
+        ulp = max_ulp_diff(net_e.collect_params()[ne].data()._read(),
+                           net_c.collect_params()[nc].data()._read())
+        worst_ulp = max(worst_ulp, ulp)
+    assert worst_ulp <= ulp_tol, \
+        "compiled step diverged from bucketed-eager by %s ULP" % worst_ulp
+    assert cstep.retraces == 1, \
+        "static-shape loop retraced the compiled step (%d traces)" \
+        % cstep.retraces
+    return {
+        "compiled_step_params": n_params,
+        "compiled_step_eager_ms": round(best["eager"] * 1e3, 3),
+        "compiled_step_compiled_ms": round(best["compiled"] * 1e3, 3),
+        "compiled_step_latency_ratio": round(
+            best["compiled"] / best["eager"], 3),
+        "compiled_step_speedup": round(
+            best["eager"] / best["compiled"], 2),
+        "compiled_step_backend": jax.default_backend(),
+        "compiled_step_parity_ulp": int(worst_ulp),
+        "compiled_step_retraces": cstep.retraces,
+        "compiled_step_compiled_total": cstep.compiled_steps,
+        "compiled_step_fallback_total": cstep.fallback_steps,
+    }
+
+
 def _lens_overhead_bench(iters=20, repeats=4, n_params=8, shape=(16, 16)):
     """graftlens steady-state cost on a real train loop (record scope,
     backward, kvstore collectives, step journal — every lens source
@@ -585,6 +699,12 @@ def smoke():
     res = _fused_step_bench(iters=3)
     res.update(_overlap_step_bench(iters=4, repeats=2))
     res.update(_duplex_step_bench(iters=4, repeats=2))
+    res.update(_compiled_step_bench(iters=4, repeats=2))
+    # graftstep acceptance gate: the compiled steady-state step must
+    # beat bucketed-eager by >= 1.25x (ratio <= 0.8) on this model
+    assert res["compiled_step_latency_ratio"] <= 0.8, \
+        "compiled step is not fast enough: ratio %.3f > 0.8" \
+        % res["compiled_step_latency_ratio"]
     res.update(_blackbox_overhead_bench(iters=10, repeats=3))
     res.update(_lens_overhead_bench(iters=10, repeats=3))
     res.update(_pulse_overhead_bench(iters=10, repeats=3))
@@ -741,6 +861,9 @@ def main():
     # -- graftduplex: full-duplex update_on_kvstore step (round 9) -------
     duplex = _duplex_step_bench(iters=ITERS // 2)
 
+    # -- graftstep: whole-step compiled training (round 16) --------------
+    compiled = _compiled_step_bench(iters=ITERS // 2)
+
     # -- graftwatch: flight-recorder overhead on the same 64-op chain ----
     blackbox_overhead = _blackbox_overhead_bench()
 
@@ -757,6 +880,7 @@ def main():
         **fused,
         **overlap,
         **duplex,
+        **compiled,
         **blackbox_overhead,
         **lens_overhead,
         **pulse_overhead,

@@ -72,6 +72,16 @@ def is_recording():
     return _st().recording
 
 
+def head_seed(value):
+    """THE backward seeding rule for a head with no explicit head_grad:
+    ones of the head's shape/dtype (``d(sum)/d`` semantics, parity with
+    the reference's ``backward()``).  Single source of truth shared by
+    the tape walk (:func:`_run_backward`) and the compiled whole-step
+    vjp (``gluon/step_compile.py``), so ``loss.backward()`` and the
+    fused fwd+bwd program are seeded identically by construction."""
+    return jnp.ones_like(value)
+
+
 def is_training():
     return _st().training
 
@@ -207,7 +217,7 @@ def _run_backward(heads, head_grads, retain_graph, train_mode, variables=None,
     from ..ops.registry import get_op
 
     def _seed(h, hg):
-        v = jnp.ones_like(h._read()) if hg is None else hg._read()
+        v = head_seed(h._read()) if hg is None else hg._read()
         return NDArray(v) if create_graph else v
 
     for i, h in enumerate(heads):

@@ -21,6 +21,8 @@ consumer-thread behavior.
 """
 from __future__ import annotations
 
+import os
+
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -42,6 +44,21 @@ def default_batchify_fn(data):
         return [default_batchify_fn(i) for i in data]
     data = np.asarray(data)
     return _nd.array(data, dtype=data.dtype)
+
+
+def prefetch_depth_default():
+    """GRAFT_PREFETCH_DEPTH (default 2, floor 1): how many lookahead
+    batches the pooled pipeline keeps in flight beyond what the worker
+    count implies.  2 is classic double-buffering; deeper absorbs
+    per-batch build-time variance (one slow batch no longer stalls the
+    consumer) at the cost of that many batches resident on host.  The
+    graftpulse autotuner grows a loader's LIVE depth past this default
+    when worker growth alone can't close a ``data_wait`` signal."""
+    try:
+        v = int(os.environ.get("GRAFT_PREFETCH_DEPTH", "2"))
+    except ValueError:
+        v = 2
+    return max(1, v)
 
 
 class DataLoader(object):
@@ -72,6 +89,7 @@ class DataLoader(object):
                              "not be specified if batch_sampler is specified.")
         self._batch_sampler = batch_sampler
         self._num_workers = num_workers
+        self._prefetch_depth = None     # None = GRAFT_PREFETCH_DEPTH
         self._pool = None       # lazily-created per-loader worker pool
         self._blocked_wait_s = 0.0      # cumulative consumer-blocked wait
         #                                 (the autotuner ranks loaders by
@@ -108,6 +126,21 @@ class DataLoader(object):
             # the existing threads (full growth after close() rebuilds
             # the pool) instead of silently "growing" a dead attribute
             pool._max_workers = n
+
+    def prefetch_depth(self):
+        """Effective lookahead depth: the live per-loader override when
+        one is set (``set_prefetch_depth``), else
+        :func:`prefetch_depth_default`."""
+        d = self._prefetch_depth
+        return prefetch_depth_default() if d is None else d
+
+    def set_prefetch_depth(self, n):
+        """Re-tune the lookahead depth LIVE (the graftpulse autotuner's
+        second data knob).  Like ``set_num_workers``, an open epoch
+        iterator re-reads the depth on its next batch, so growth deepens
+        the pipeline mid-epoch; shrinking drains naturally (in-flight
+        futures complete, top-up just stops earlier)."""
+        self._prefetch_depth = max(1, int(n))
 
     def _worker_pool(self):
         """The loader's thread pool, created on first use and REUSED
@@ -185,9 +218,10 @@ class DataLoader(object):
 
         def top_up():
             # lookahead depth is re-read each batch so a live
-            # set_num_workers (the autotuner's grow) deepens the
-            # pipeline mid-epoch instead of waiting for the next one
-            want = max(2, self._num_workers)
+            # set_num_workers / set_prefetch_depth (the autotuner's
+            # grows) deepens the pipeline mid-epoch instead of waiting
+            # for the next one
+            want = max(self.prefetch_depth(), self._num_workers)
             try:
                 while len(futures) < want:
                     futures.append(pool.submit(make, next(it)))

@@ -327,7 +327,7 @@ class KVStore(object):
         """Batched multi-key pull (companion of :meth:`push_many`)."""
         return self.pull(list(keys), outs, priority=priority)
 
-    def reduce_many(self, values):
+    def reduce_many(self, values, label=None):
         """Reduce a list of dense NDArrays across workers IN PLACE with
         as few collectives as possible (one per dtype group on the dist
         wire) and return them.  This is the raw bucket wire the fused
@@ -335,14 +335,17 @@ class KVStore(object):
         server-side updater — just the allreduce.  Single-process stores
         have nothing to reduce, but the push/pull byte counters still
         observe the payload so fused vs per-param runs report comparable
-        kvstore telemetry."""
+        kvstore telemetry.  ``label`` names the flight-recorder bracket
+        (graftstep tags its program-boundary reduce "compiled_step" so a
+        hang between the fwd+bwd and update programs is attributable)."""
         if not values:
             return values
         raw = sum(_nd_bytes(v) for v in values)
         _tmetrics.kvstore_push(raw, raw)
         _tmetrics.kvstore_pull(raw)
+        extra = {"label": label} if label else {}
         with _blackbox.collective("reduce_many", n_keys=len(values),
-                                  nbytes=raw):
+                                  nbytes=raw, **extra):
             return self._cross_worker_reduce_many(list(values))
 
     def reduce_many_async(self, values, label=None):

@@ -742,18 +742,19 @@ def _fused_config(optimizer, kind):
     raise ValueError("unknown fused kind %r" % kind)
 
 
-def _build_fused_program(kind, cfg, shapes, flat_mode, has_state,
-                         lrs, wds, rescale):
-    """One unflatten→update→reflatten program over a whole bucket.
+def fused_formula_applier(kind, cfg, has_state):
+    """The per-bucket multi-tensor update as a PURE function —
+    ``apply(weights, gs, states, lrs, wds, rescale) -> (new_w, new_s)``
+    — composable into a LARGER trace (the graftstep whole-step program
+    fuses it after ``jax.vjp``'s backward, ``gluon/step_compile.py``).
 
-    lr/wd/rescale are baked in as python-float CONSTANTS, exactly as the
-    per-param path bakes them into each op's jitted partial — traced
-    scalar operands occasionally shift LLVM's fma-contraction choices by
-    1 ULP (measured on bf16 mp_sgd), and constants are the only layout
-    that compiles each param's formula identically to its standalone
-    program.  The per-param ``Operator.bind`` cache keys on the same
-    scalars, so a changing lr schedule costs the fused path exactly the
-    retraces it already cost the per-param path."""
+    ``lrs``/``wds``/``rescale`` may be python floats (the constant
+    layout :func:`_build_fused_program` bakes — bit-identical to the
+    per-param path) or traced scalar operands (the compiled whole-step
+    path, where ``set_learning_rate`` must NOT retrace; operands can
+    shift LLVM's fma-contraction choices by ~1 ULP vs the constant
+    layout — measured on bf16 mp_sgd — which is the documented
+    EH104-style tolerance the graftstep parity tests assert under)."""
     if kind in ("sgd", "mp_sgd"):
         momentum, clip = cfg
     else:
@@ -764,8 +765,7 @@ def _build_fused_program(kind, cfg, shapes, flat_mode, has_state,
     mp_sgd_mom_fc = get_op("mp_sgd_mom_update").fcompute
     adam_fc = get_op("adam_update").fcompute
 
-    def step(weights, grads, states):
-        gs = unflatten(grads, shapes) if flat_mode else grads
+    def apply(weights, gs, states, lrs, wds, rescale):
         new_w, new_s = [], []
         for k, w in enumerate(weights):
             g = gs[k]
@@ -806,6 +806,30 @@ def _build_fused_program(kind, cfg, shapes, flat_mode, has_state,
                 new_w.append(w2)
                 new_s.append((m2, v2))
         return tuple(new_w), tuple(new_s)
+
+    return apply
+
+
+def _build_fused_program(kind, cfg, shapes, flat_mode, has_state,
+                         lrs, wds, rescale):
+    """One unflatten→update→reflatten program over a whole bucket.
+
+    lr/wd/rescale are baked in as python-float CONSTANTS, exactly as the
+    per-param path bakes them into each op's jitted partial — traced
+    scalar operands occasionally shift LLVM's fma-contraction choices by
+    1 ULP (measured on bf16 mp_sgd), and constants are the only layout
+    that compiles each param's formula identically to its standalone
+    program.  The per-param ``Operator.bind`` cache keys on the same
+    scalars, so a changing lr schedule costs the fused path exactly the
+    retraces it already cost the per-param path.  The formulas
+    themselves come from :func:`fused_formula_applier` — one source,
+    shared with the graftstep whole-step program (which passes the same
+    scalars as traced operands instead)."""
+    apply = fused_formula_applier(kind, cfg, has_state)
+
+    def step(weights, grads, states):
+        gs = unflatten(grads, shapes) if flat_mode else grads
+        return apply(weights, gs, states, lrs, wds, rescale)
 
     return jax.jit(step)
 

@@ -11,7 +11,14 @@ carry-forward), guarded and default-off (``GRAFT_AUTOTUNE``):
   ``GRAFT_AUTOTUNE_MAX_WORKERS``, default 8) via
   ``DataLoader.set_num_workers`` — the pool grows IN PLACE and the
   epoch iterator tops its lookahead up mid-epoch, so a starved loop
-  recovers without an epoch boundary.
+  recovers without an epoch boundary.  When every starved loader is
+  already at the worker cap, the controller escalates to the loader's
+  prefetch lookahead instead (``DataLoader.set_prefetch_depth``,
+  doubling from ``GRAFT_PREFETCH_DEPTH`` up to
+  ``GRAFT_AUTOTUNE_MAX_PREFETCH``, default 8) — deeper lookahead
+  absorbs per-batch build-time variance that more threads cannot.
+  Both knobs share the cooldown discipline and journal their own
+  decisions (``dataloader_workers`` / ``prefetch_depth``).
 
 * **comm_hidden_ratio → GRAFT_BUCKET_BYTES** — when the window's
   hidden-comm ratio (1 - blocked/in-flight collective time) sags below
@@ -107,7 +114,8 @@ class Autotuner(object):
 
     def __init__(self, interval=None, cooldown=None, data_wait_bound=None,
                  comm_hidden_bound=None, max_workers=None,
-                 min_bucket_bytes=None, max_bucket_bytes=None):
+                 min_bucket_bytes=None, max_bucket_bytes=None,
+                 max_prefetch=None):
         self.interval = interval if interval is not None \
             else _env_int("GRAFT_AUTOTUNE_INTERVAL", 8)
         self.cooldown = cooldown if cooldown is not None \
@@ -125,6 +133,8 @@ class Autotuner(object):
         self.max_bucket_bytes = max_bucket_bytes \
             if max_bucket_bytes is not None \
             else _env_int("GRAFT_AUTOTUNE_MAX_BUCKET_BYTES", 64 << 20)
+        self.max_prefetch = max_prefetch if max_prefetch is not None \
+            else _env_int("GRAFT_AUTOTUNE_MAX_PREFETCH", 8)
         self._lock = threading.Lock()
         self._loaders = []          # weakrefs, registration order
         self._trainers = []         # weakrefs
@@ -191,13 +201,21 @@ class Autotuner(object):
             hidden = max(0.0, min(1.0, 1.0 - blocked / inflight))
             _metrics.autotune_signal("comm_hidden_ratio", hidden)
         if data_frac > self.data_wait_bound:
-            self._grow_workers(data_frac)
+            # worker growth first (more parallel batch builds); when
+            # every starved loader is already at the worker cap, deepen
+            # its prefetch lookahead instead — more in-flight batches
+            # absorb build-time variance the extra threads can't
+            if not self._grow_workers(data_frac):
+                self._grow_prefetch(data_frac)
         if hidden is not None:
             self._tune_bucket_bytes(hidden)
 
     def _grow_workers(self, data_frac):
+        """Returns True when a worker-growth decision was made (or the
+        knob is cooling down from one), False when no loader can grow —
+        the caller then escalates to the prefetch-depth knob."""
         if "dataloader_workers" in self._cooldowns:
-            return
+            return True
         # rank by the blocked-wait DELTA since this loader was last
         # considered: the window's data_wait belongs to the loader the
         # consumer actually stalled on — growing in registration order
@@ -221,6 +239,41 @@ class Autotuner(object):
             except Exception:
                 continue
             self._decide("data_wait", "dataloader_workers", old, new,
+                         data_wait_fraction=round(data_frac, 4))
+            return True
+        return False
+
+    def _grow_prefetch(self, data_frac):
+        """Second data knob (graftstep satellite): when worker growth is
+        exhausted but ``data_wait`` still exceeds the bound, double the
+        starved loader's LIVE lookahead depth
+        (``DataLoader.set_prefetch_depth``, capped at
+        ``GRAFT_AUTOTUNE_MAX_PREFETCH``).  Deeper lookahead lets the
+        existing threads run ahead of the consumer, so one slow batch no
+        longer stalls the loop.  Same cooldown discipline as every knob;
+        the decision is journaled to the flight recorder
+        (``autotune_decision`` with knob ``prefetch_depth``)."""
+        if "prefetch_depth" in self._cooldowns:
+            return
+        ranked = []
+        for loader in self._live(self._loaders):
+            if not hasattr(loader, "set_prefetch_depth"):
+                continue
+            total = float(getattr(loader, "_blocked_wait_s", 0.0))
+            seen = float(getattr(loader, "_graft_autotune_pf_seen", 0.0))
+            loader._graft_autotune_pf_seen = total
+            ranked.append((total - seen, loader))
+        ranked.sort(key=lambda pair: -pair[0])
+        for _delta, loader in ranked:
+            old = int(loader.prefetch_depth())
+            new = min(self.max_prefetch, max(1, old * 2))
+            if new <= old:
+                continue        # at the cap — try the next loader
+            try:
+                loader.set_prefetch_depth(new)
+            except Exception:
+                continue
+            self._decide("data_wait", "prefetch_depth", old, new,
                          data_wait_fraction=round(data_frac, 4))
             return
 

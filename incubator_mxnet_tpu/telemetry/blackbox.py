@@ -480,7 +480,8 @@ class _StepJournal(object):
 
 
 def _lens_extra(fields):
-    extra = {k: fields[k] for k in ("overlapped", "fused", "batch_size")
+    extra = {k: fields[k]
+             for k in ("overlapped", "fused", "batch_size", "compiled")
              if k in fields}
     return extra or None
 

@@ -844,6 +844,11 @@ def compact(rec):
         out["device_busy_ms"] = round(rec["device"]["busy_s"] * 1e3, 3)
     if "mem" in rec:
         out["mem_peak_bytes"] = rec["mem"]["peak_bytes"]
+    if rec.get("compiled"):
+        # graftstep: a whole-step compiled window — one donated XLA
+        # program booked as a single device span; flagged so step rings
+        # distinguish compiled from bucketed-eager windows at a glance
+        out["compiled"] = True
     return out
 
 

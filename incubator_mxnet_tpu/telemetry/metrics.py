@@ -625,6 +625,39 @@ def trainer_fused_update(n_params):
         n_params)
 
 
+def trainer_compiled_step(n_params):
+    """One whole-step compiled dispatch (graftstep: fwd+bwd+fused update
+    as a single donated XLA program, gluon/step_compile.py)."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    r.counter("graft_trainer_compiled_steps_total",
+              "Whole-step compiled training dispatches").inc()
+    r.counter("graft_trainer_compiled_params_total",
+              "Parameters updated through whole-step compiled "
+              "dispatches").inc(n_params)
+
+
+def trainer_compiled_retrace():
+    """One graftstep guard miss that built (or rebuilt) a compiled-step
+    entry — steady-state loops must show zero of these after step 2."""
+    if not enabled():
+        return
+    _REGISTRY.counter("graft_trainer_compiled_retraces_total",
+                      "Compiled-step guard misses that re-traced").inc()
+
+
+def trainer_compiled_fallback(reason):
+    """One graftstep step that ran the bucketed-eager fallback instead
+    of the compiled program, labeled by why."""
+    if not enabled():
+        return
+    _REGISTRY.counter("graft_trainer_compiled_fallbacks_total",
+                      "Compiled-step dispatches that fell back to the "
+                      "bucketed-eager path",
+                      ("reason",)).inc(reason=reason)
+
+
 # -- graftlens: per-step wall-time attribution --------------------------------
 
 

@@ -55,6 +55,15 @@
 #    decision journaled to the flight recorder; bench_eager --smoke
 #    (tier 3) additionally reports pulse_overhead_pct (the async device
 #    ledger's cost) against its < 2% budget in BENCH JSON.
+# 10. graftstep smoke — gluon.step_compile --selftest drives the
+#    whole-step compiled training path: one lazy trace on a static-shape
+#    loop (zero retraces after step 2), a set_learning_rate that must
+#    NOT retrace (lr rides as a traced operand), at most one guarded
+#    retrace per shape change, and ULP-tolerance parity of params +
+#    optimizer states against the bucketed-eager triple at every stage;
+#    bench_eager --smoke (tier 3) additionally gates the
+#    compiled_step_latency_ratio (compiled steady-state <= 0.8x the
+#    bucketed-eager step on the 64-param dist_sync bench) in BENCH JSON.
 #
 # Usage: tools/run_lint.sh [report.json]
 set -uo pipefail
@@ -82,5 +91,8 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     || exit $?
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m incubator_mxnet_tpu.telemetry.autotune --selftest \
+    || exit $?
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m incubator_mxnet_tpu.gluon.step_compile --selftest \
     || exit $?
 exec python -m incubator_mxnet_tpu.telemetry --selftest
