@@ -52,6 +52,12 @@ Round 12 (graftpulse) adds ``pulse_overhead_pct``: a bulked ASYNC train
 loop (no sync mode — flush-boundary reaper enqueues and mem-timeline
 probes firing) with the async device-time ledger on vs off, each round
 draining the reaper inside its own window.  Same < 2% bar as the lens.
+
+Round 17 (graftguard) adds ``compile_check_overhead_pct``: the compiled
+whole-step path (graftstep) timed with the EH3xx runtime auditor armed
+(guard-key bookkeeping, bake-hash recheck, donated-buffer poisoning and
+sweep — but NO sentinel replay) vs off.  Same < 2% bar; the off mode
+additionally asserts the hot-path flag is a cached list-index load.
 """
 import json
 import sys
@@ -692,6 +698,93 @@ def _armor_overhead_bench(iters=25, repeats=2):
     }
 
 
+def _compile_check_overhead_bench(iters=50, repeats=9):
+    """graftguard inertness: the EH3xx auditor armed on the compiled
+    whole-step path (note_call/guard bookkeeping, per-dispatch bake-hash
+    recheck, donated-buffer poison + sweep; the EH304 sentinel stays off
+    — it deliberately doubles the dispatch) vs the default-off path,
+    against the same CompiledStep.  The estimator is PAIRED: every
+    iteration times one off call and one armed call back-to-back
+    (alternating which mode goes first so warm-cache ordering bias
+    cancels), and the reported figure is the median of the per-pair
+    deltas over the pooled median off time.  The auditor's cost is a
+    few us on a ~ms step while this single-core box drifts by tens of
+    percent between separately-sampled windows (scheduler stalls, GC,
+    frequency scaling) — only samples taken microseconds apart share
+    enough machine state for the difference to mean anything, and a
+    GC hit on one side of a single pair lands in that pair's delta
+    alone, where the median discards it.  The off mode must be a
+    cached flag load (memoized env read, poison map empty) and the
+    armed rounds must report ZERO findings."""
+    import os
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.analysis import compile_safety as csafety
+    from incubator_mxnet_tpu.gluon import step_compile as sc
+
+    # (16, 16) params like the other overhead benches — the auditor's
+    # cost is a fixed few us per step, so a microscopic step would
+    # report an overhead % no real workload sees
+    net = sc._make_net("bench_guard_", n_params=8, shape=(16, 16))
+    sc._seed_params(net)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.01, "momentum": 0.9},
+                       kvstore=None)
+    cstep = sc.CompiledStep(tr, net, enabled=True)
+    x = mx.nd.array(
+        np.random.RandomState(3).rand(16, 16).astype(np.float32))
+    for _ in range(3):              # kv init + lazy trace + steady state
+        cstep(x)
+    assert cstep.compiled_steps >= 1, "bench never reached compiled path"
+
+    import statistics
+
+    all_offs, deltas = [], []
+
+    def paired_round(flip):
+        """One round of `iters` off/armed pairs appended to the pools;
+        `flip` swaps which mode runs first within each pair."""
+        for i in range(iters):
+            pair = {}
+            order = (False, True) if (i + flip) % 2 == 0 else (True, False)
+            for armed in order:
+                csafety.set_enabled(True if armed else None)
+                t0 = time.perf_counter()
+                cstep(x)
+                pair[armed] = time.perf_counter() - t0
+            all_offs.append(pair[False])
+            deltas.append(pair[True] - pair[False])
+        # off = one cached flag load on the hot path
+        csafety.set_enabled(None)
+        assert not csafety._ACTIVE[0] and not csafety._POISON, \
+            "auditor left armed state behind when off"
+
+    prev_every = os.environ.pop("GRAFT_COMPILE_CHECK_EVERY", None)
+    try:
+        for armed in (True, False):              # warm both modes once
+            csafety.set_enabled(True if armed else None)
+            for _ in range(4):
+                cstep(x)
+        for r in range(repeats):
+            paired_round(r)
+        aud = cstep._auditor
+        if aud is not None and aud.storms:
+            raise AssertionError(
+                "graftguard bench: %d storm report(s) on a static-shape "
+                "loop" % aud.storms)
+    finally:
+        csafety.set_enabled(None)
+        if prev_every is not None:
+            os.environ["GRAFT_COMPILE_CHECK_EVERY"] = prev_every
+    off_med = statistics.median(all_offs)
+    pct = statistics.median(deltas) / off_med * 100.0
+    return {
+        "compile_check_steps_per_sec": round(1.0 / off_med, 1),
+        "compile_check_overhead_pct": round(pct, 2),
+    }
+
+
 def smoke():
     """Fast path for the lint tier: exercise the bucketed step +
     bit-parity assert in a few seconds, print one JSON line."""
@@ -710,6 +803,12 @@ def smoke():
     res.update(_pulse_overhead_bench(iters=10, repeats=3))
     res.update(_tsan_overhead_bench(iters=8, repeats=2))
     res.update(_armor_overhead_bench(iters=25, repeats=2))
+    res.update(_compile_check_overhead_bench(iters=50, repeats=9))
+    # graftguard acceptance gate: auditor armed (no sentinel) must cost
+    # < 2% on the compiled step
+    assert res["compile_check_overhead_pct"] < 2.0, \
+        "compile-check auditor overhead %.2f%% >= 2%%" \
+        % res["compile_check_overhead_pct"]
     res["metric"] = "fused_step_smoke"
     res["backend"] = jax.default_backend()
     print(json.dumps(res))

@@ -1,21 +1,30 @@
-"""graftlint — CLI for the op-contract + concurrency linters.
+"""graftlint — CLI for the op-contract + concurrency + compile-safety
+linters.
 
 Usage::
 
     python -m incubator_mxnet_tpu.analysis.graftlint [--all] [--json]
-           [--ops NAME[,NAME...]] [--list-rules]
+           [--ops NAME[,NAME...]] [--list-rules] [--baseline PATH]
 
 Imports the full ops package (registration side effects populate the
 registry and the registration log), runs every contract rule (GL1xx),
 then the static concurrency rules (GL2xx — lock-order inversions,
 unguarded thread-shared globals, ``_sched_*`` protocol completeness,
-daemon threads without shutdown paths; analysis/concurrency.py) over the
-package sources, and exits non-zero on unsuppressed findings.  ``--ops``
-restricts to the op-contract pass.  ``--json`` emits the
+daemon threads without shutdown paths; analysis/concurrency.py) and the
+compile-safety rules (GL3xx — host round-trips / traced branching /
+constant-baked hyperparameters / donation hazards in trace-eligible
+closures; analysis/compile_safety.py) over the package sources, and
+exits non-zero on unsuppressed findings.  ``--ops`` restricts to the
+op-contract + registry compile-safety passes.  ``--json`` emits the
 machine-readable report to stdout, ``--report PATH`` writes it to a file
-alongside the human summary (one linter pass serves both), and
+alongside the human summary (one linter pass serves both),
 ``--contracts`` dumps every registered op's machine-readable contract
 (Operator.contract()).
+
+Baselines: ``--write-baseline PATH`` snapshots the current unsuppressed
+findings; a later run with ``--baseline PATH`` fails ONLY on findings
+not in the snapshot (new code held strict, legacy debt non-blocking) —
+masked findings are still printed and counted.
 
 Linting is platform-independent, so the CLI pins jax to CPU before the
 ops import — the axon sitecustomize otherwise force-selects the TPU
@@ -56,8 +65,53 @@ def _report_json(diags):
     }
 
 
+def _baseline_key(d):
+    """Identity of a finding across unrelated edits: code + site + the
+    file's basename (absolute paths differ per checkout; line numbers
+    drift with every edit above them, so they are deliberately NOT part
+    of the key — the baseline masks by count per key instead)."""
+    return "%s|%s|%s" % (d.code, d.op_name,
+                         os.path.basename(d.file) if d.file else "-")
+
+
+def _baseline_counts(diags):
+    counts = {}
+    for d in diags:
+        if d.suppressed:
+            continue
+        k = _baseline_key(d)
+        counts[k] = counts.get(k, 0) + 1
+    return counts
+
+
+def write_baseline(path, diags):
+    with open(path, "w") as f:
+        json.dump({"version": 1, "counts": _baseline_counts(diags)},
+                  f, indent=2, sort_keys=True)
+
+
+def apply_baseline(path, diags):
+    """Split active findings into (new, masked) against a snapshot.
+    Per key, up to the snapshot's count is masked; anything beyond it
+    (or any unseen key) is new and fails the run."""
+    with open(path) as f:
+        doc = json.load(f)
+    budget = dict(doc.get("counts") or {})
+    new, masked = [], []
+    for d in diags:
+        if d.suppressed:
+            continue
+        k = _baseline_key(d)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            masked.append(d)
+        else:
+            new.append(d)
+    return new, masked
+
+
 def main(argv=None):
-    from . import concurrency, contracts
+    from . import compile_safety, concurrency, contracts
 
     ap = argparse.ArgumentParser(
         prog="graftlint", description="op-contract static analyzer")
@@ -74,13 +128,23 @@ def main(argv=None):
                          "JSON and exit")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the diagnostic codes and exit")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help="fail only on findings NOT in this snapshot "
+                         "(legacy debt stays non-blocking)")
+    ap.add_argument("--write-baseline", default=None, metavar="PATH",
+                    help="snapshot the current unsuppressed findings "
+                         "and exit 0")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         rules = dict(contracts.RULES)
         rules.update(concurrency.RULES)
+        rules.update(compile_safety.RULES)
         for code in sorted(rules):
             print("%s  %s" % (code, rules[code]))
+        for code in sorted(compile_safety.EH_RULES):
+            print("%s  %s (runtime, GRAFT_COMPILE_CHECK=1)"
+                  % (code, compile_safety.EH_RULES[code]))
         return 0
 
     _force_cpu_platform()
@@ -101,12 +165,30 @@ def main(argv=None):
         return 0
 
     diags = contracts.lint_all(names=names)
+    diags += compile_safety.lint_registry(names=names)
     if names is None:
-        # the concurrency tier lints the package sources, not ops — an
-        # --ops-restricted run (fixture tests) skips it
+        # the concurrency + compile-safety tiers lint the package
+        # sources, not ops — an --ops-restricted run (fixture tests)
+        # skips them
         diags += concurrency.lint_package()
+        diags += compile_safety.lint_package()
     active = [d for d in diags if not d.suppressed]
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, diags)
+        print("graftlint: baseline of %d finding(s) written to %s"
+              % (len(active), args.write_baseline))
+        return 0
+
+    masked = []
+    if args.baseline:
+        active, masked = apply_baseline(args.baseline, diags)
+
     report = _report_json(diags)
+    if args.baseline:
+        report["baseline"] = {"path": args.baseline,
+                              "masked": len(masked),
+                              "new": len(active)}
 
     if args.report:
         with open(args.report, "w") as f:
@@ -121,6 +203,9 @@ def main(argv=None):
                            sum(1 for d in diags if d.suppressed),
                            len(names) if names is not None else
                            _registry_size()))
+        if masked:
+            print("graftlint: %d baseline-masked finding(s) (%s)"
+                  % (len(masked), args.baseline))
         if args.report:
             print("graftlint: JSON report at %s" % args.report)
     return 1 if active else 0

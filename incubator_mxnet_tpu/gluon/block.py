@@ -304,6 +304,7 @@ class CachedOp(object):
             # version counter moved ⇒ the trace mutated it
             aux_updates = {name: sh._read() for name, sh in shadows.items()
                            if sh._version > 0}
+            # graftlint: disable=GL304 -- trace-time output-fmt memo, written once per trace
             self._last_out_fmt = out_fmt
             return out_vals, aux_updates
 

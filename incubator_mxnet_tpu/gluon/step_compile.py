@@ -89,6 +89,7 @@ import jax
 from .. import autograd
 from .. import engine as _engine
 from .. import optimizer as opt
+from ..analysis import compile_safety as _csafety
 from .. import random_state
 from ..ndarray import NDArray
 from ..telemetry import blackbox as _blackbox
@@ -200,6 +201,11 @@ class CompiledStep(object):
         self.compiled_steps = 0
         self.fallback_steps = 0
         self.forward_order = None
+        # graftguard (GRAFT_COMPILE_CHECK): lazily-created runtime
+        # auditor + the last guard key, diffed on every miss so EH301
+        # can name exactly which component churned
+        self._auditor = None
+        self._last_guard_key = None
 
     # -- public -------------------------------------------------------------
     def enabled(self):
@@ -216,6 +222,10 @@ class CompiledStep(object):
         tr = self._trainer
         if not self.enabled():
             return self._fallback(args, batch_size, "disabled")
+        if _csafety.refresh():
+            if self._auditor is None:
+                self._auditor = _csafety.StepAuditor("trainer")
+            self._auditor.note_call()
         if not tr._kv_initialized:
             # first step: kvstore init + optimizer state creation ride
             # the eager path, then the trace builds lazily below
@@ -224,6 +234,7 @@ class CompiledStep(object):
         entry = self._entries.get(key)
         if entry is None:
             return self._miss(args, batch_size, "guard-miss")
+        self._last_guard_key = key
         if isinstance(entry, _Ineligible):
             return self._fallback(args, batch_size, entry.reason)
         plan_sig = self._plan_sig()
@@ -255,6 +266,21 @@ class CompiledStep(object):
         # is fresh, and the next hit on this signature dispatches
         # compiled — one fallback step per distinct signature
         key = self._guard_key(args)
+        # every miss names WHICH guard component churned: the diff feeds
+        # the always-on graft_step_retraces_total{reason} metric and the
+        # blackbox, and (when GRAFT_COMPILE_CHECK is on) the EH301
+        # retrace-storm detector
+        if reason == "guard-miss":
+            component, detail = _csafety.diff_guard_key(
+                self._last_guard_key, key)
+        else:
+            component, detail = reason, None
+        self._last_guard_key = key
+        _tmetrics.step_retrace(component)
+        _blackbox.record("step_compile", event="miss", reason=reason,
+                         component=component, detail=detail)
+        if self._auditor is not None and _csafety._ACTIVE[0]:
+            self._auditor.note_miss(component, detail)
         try:
             if self._entries.get(key) is None:
                 self._build(key, args)
@@ -304,6 +330,7 @@ class CompiledStep(object):
     def _ineligible(self, key, reason):
         self._entries[key] = _Ineligible(reason)
         _blackbox.record("step_compile", event="ineligible", reason=reason)
+        _tmetrics.step_guard_entries(len(self._entries))
         return None
 
     def _build(self, key, args):
@@ -361,20 +388,37 @@ class CompiledStep(object):
             "touch": [], "fmt_cell": {},
             "n_in": len(flat_args),
         }
+        # graftguard EH303: the fused-config scalars baked into the
+        # formula appliers at trace time, re-hashed per dispatch —
+        # drift under an unchanged guard key means a silently frozen
+        # value inside the compiled program
+        entry["bake_kinds"] = tuple(s["kind"] for s in bspecs)
+        entry["bake_sig"] = tuple(
+            tuple(opt._fused_config(tr._optimizer, s["kind"]))
+            for s in bspecs)
+
         raw_fwd = self._make_raw_fwd(entry)
         fwd_bwd = self._make_fwd_bwd(entry, raw_fwd)
         donate = (0, 1) if _donation_supported() else ()
         kv = tr._kvstore_obj
         if kv is None:
-            entry["one"] = jax.jit(self._make_one_program(entry, fwd_bwd),
-                                   donate_argnums=donate)
+            one = self._make_one_program(entry, fwd_bwd)
+            entry["one"] = jax.jit(one, donate_argnums=donate)
             entry["fwd_bwd"] = entry["update"] = None
+            # un-jitted twin for the EH304 divergence sentinel: same
+            # closure, eager dispatch — zero cost unless sampled
+            entry["one_raw"] = one
+            entry["fwd_bwd_raw"] = entry["update_raw"] = None
         else:
+            update = self._make_update_program(entry)
             entry["one"] = None
             entry["fwd_bwd"] = jax.jit(
                 lambda tv, fv, iv, rng: fwd_bwd(tv, fv, iv, rng, True))
-            entry["update"] = jax.jit(self._make_update_program(entry),
-                                      donate_argnums=donate)
+            entry["update"] = jax.jit(update, donate_argnums=donate)
+            entry["one_raw"] = None
+            entry["fwd_bwd_raw"] = \
+                lambda tv, fv, iv, rng: fwd_bwd(tv, fv, iv, rng, True)
+            entry["update_raw"] = update
 
         # dry abstract trace NOW (jax.eval_shape: no compile, no FLOPs):
         # trace errors surface here as a clean ineligible entry instead
@@ -390,6 +434,7 @@ class CompiledStep(object):
         self._entries[key] = entry
         self.retraces += 1
         _tmetrics.trainer_compiled_retrace()
+        _tmetrics.step_guard_entries(len(self._entries))
         _blackbox.record("step_compile", event="trace",
                          n_params=len(trainable), n_buckets=len(bspecs),
                          kv=kv is not None, donated=bool(donate),
@@ -457,6 +502,7 @@ class CompiledStep(object):
                         if loss is not None:
                             out = loss(out, label_nd)
             flat_out, fmt = _flatten(out, "output")
+            # graftlint: disable=GL304 -- trace-time output-fmt memo, written once per trace
             fmt_cell["fmt"] = fmt
             out_vals = tuple(o._read() for o in flat_out)
             for n in train_names:
@@ -548,8 +594,9 @@ class CompiledStep(object):
             # land any open deferred segment ONCE with an attributed
             # cause (param/state leaves may be deferred values)
             _engine.flush(cause="step_compile")
-        train_vals = tuple(tr._params[i].list_data()[0]._read()
-                           for i in entry["trainable"])
+        train_nds = [tr._params[i].list_data()[0]
+                     for i in entry["trainable"]]
+        train_vals = tuple(a._read() for a in train_nds)
         block_params = self._block.collect_params()
         frozen_nds = [block_params[n].list_data()[0]
                       for n in entry["frozen_names"]]
@@ -571,7 +618,7 @@ class CompiledStep(object):
             state_vals.append(tuple(tuple(a._read() for a in arrs)
                                     for arrs in nds))
         return (train_vals, frozen_vals, input_vals, frozen_nds,
-                state_nds, tuple(state_vals))
+                state_nds, tuple(state_vals), train_nds)
 
     def _dispatch(self, entry, args, batch_size):
         tr = self._trainer
@@ -581,7 +628,7 @@ class CompiledStep(object):
         if gathered is None:
             return self._miss(args, batch_size, "state-arity")
         (train_vals, frozen_vals, input_vals, frozen_nds,
-         state_nds, state_vals) = gathered
+         state_nds, state_vals, train_nds) = gathered
         # host bookkeeping ticks in the exact _bucketed_update order
         # (bucket outer, param inner) — update counts, schedulers and
         # Adam's bias correction see the same sequence as eager; the
@@ -602,49 +649,103 @@ class CompiledStep(object):
         kv = tr._kvstore_obj
         ctx = tr._contexts[0]
 
-        with _blackbox.step_journal("trainer", batch_size=batch_size,
-                                    fused=True, overlapped=False,
-                                    duplex=False, compiled=True):
-            with _ttracing.phase_span("kvstore"):
-                # settle any in-flight pulls from a preceding fallback
-                # step; compiled steps never arm the mid-backward
-                # scheduler (no eager backward → no grad-ready hooks)
-                tr._pull_scheduler.finish()
-                if tr._scheduler._armed:
-                    tr._scheduler.disarm()
-            with _engine.offband():
-                if kv is None:
-                    with _ttracing.phase_span("update"):
-                        t0 = time.perf_counter()
-                        outs, aux, new_w, new_s = entry["one"](
-                            train_vals, state_vals, frozen_vals,
-                            input_vals, rng, lrs, wds, rescale)
-                        _lens.device_async(
-                            [new_w[-1] if new_w else outs[0]], t0)
-                        self._write_back(entry, new_w, new_s, state_nds,
-                                         frozen_nds, aux)
-                else:
-                    with _ttracing.phase_span("fwd"):
-                        t0 = time.perf_counter()
-                        outs, aux, flats = entry["fwd_bwd"](
-                            train_vals, frozen_vals, input_vals, rng)
-                        _lens.device_async([flats[-1]], t0)
-                    with _ttracing.phase_span("kvstore"):
-                        # cross-worker reduce AT the program boundary:
-                        # the existing wire, same bytes, same algebra
-                        flat_nds = [NDArray(f, ctx=ctx) for f in flats]
-                        kv.reduce_many(flat_nds, label="compiled_step")
-                        reduced = tuple(f._read() for f in flat_nds)
-                    with _ttracing.phase_span("update"):
-                        t1 = time.perf_counter()
-                        new_w, new_s = entry["update"](
-                            train_vals, state_vals, reduced,
-                            lrs, wds, rescale)
-                        _lens.device_async(
-                            [new_w[-1] if new_w else reduced[-1]], t1)
-                        self._write_back(entry, new_w, new_s, state_nds,
-                                         frozen_nds, aux)
-                _lens.mem_sample("compiled_step")
+        # graftguard (GRAFT_COMPILE_CHECK): EH303 re-hashes the fused
+        # config against the trace-time bake, EH302 poisons the donated
+        # buffers for the dispatch window, EH304 replays the un-jitted
+        # twin on sampled steps (same operands, same rng key)
+        aud = self._auditor if _csafety._ACTIVE[0] else None
+        sentinel = deep = False
+        if aud is not None:
+            deep = aud.deep_due()
+            if deep:
+                aud.check_bake(
+                    entry["bake_kinds"], entry["bake_sig"],
+                    tuple(tuple(opt._fused_config(optimizer, k))
+                          for k in entry["bake_kinds"]))
+            sentinel = aud.sentinel_due()
+
+        try:
+            with _blackbox.step_journal("trainer", batch_size=batch_size,
+                                        fused=True, overlapped=False,
+                                        duplex=False, compiled=True):
+                with _ttracing.phase_span("kvstore"):
+                    # settle any in-flight pulls from a preceding
+                    # fallback step; compiled steps never arm the
+                    # mid-backward scheduler (no eager backward → no
+                    # grad-ready hooks)
+                    tr._pull_scheduler.finish()
+                    if tr._scheduler._armed:
+                        tr._scheduler.disarm()
+                with _engine.offband():
+                    if kv is None:
+                        with _ttracing.phase_span("update"):
+                            ref = None
+                            if sentinel:
+                                ref = entry["one_raw"](
+                                    train_vals, state_vals, frozen_vals,
+                                    input_vals, rng, lrs, wds, rescale)
+                            if deep:
+                                aud.poison(_donated_nds(train_nds,
+                                                        state_nds),
+                                           "one")
+                            t0 = time.perf_counter()
+                            outs, aux, new_w, new_s = entry["one"](
+                                train_vals, state_vals, frozen_vals,
+                                input_vals, rng, lrs, wds, rescale)
+                            _lens.device_async(
+                                [new_w[-1] if new_w else outs[0]], t0)
+                            if ref is not None:
+                                aud.check_parity(
+                                    "one", (outs, aux, new_w, new_s),
+                                    ref)
+                            self._write_back(entry, new_w, new_s,
+                                             state_nds, frozen_nds, aux)
+                    else:
+                        with _ttracing.phase_span("fwd"):
+                            t0 = time.perf_counter()
+                            outs, aux, flats = entry["fwd_bwd"](
+                                train_vals, frozen_vals, input_vals, rng)
+                            _lens.device_async([flats[-1]], t0)
+                        with _ttracing.phase_span("kvstore"):
+                            # cross-worker reduce AT the program
+                            # boundary: the existing wire, same bytes,
+                            # same algebra
+                            flat_nds = [NDArray(f, ctx=ctx)
+                                        for f in flats]
+                            kv.reduce_many(flat_nds,
+                                           label="compiled_step")
+                            reduced = tuple(f._read() for f in flat_nds)
+                        with _ttracing.phase_span("update"):
+                            ref_u = None
+                            if sentinel:
+                                aud.check_parity(
+                                    "fwd_bwd", (outs, aux, flats),
+                                    entry["fwd_bwd_raw"](
+                                        train_vals, frozen_vals,
+                                        input_vals, rng))
+                                ref_u = entry["update_raw"](
+                                    train_vals, state_vals, reduced,
+                                    lrs, wds, rescale)
+                            if deep:
+                                aud.poison(_donated_nds(train_nds,
+                                                        state_nds),
+                                           "update")
+                            t1 = time.perf_counter()
+                            new_w, new_s = entry["update"](
+                                train_vals, state_vals, reduced,
+                                lrs, wds, rescale)
+                            _lens.device_async(
+                                [new_w[-1] if new_w else reduced[-1]],
+                                t1)
+                            if ref_u is not None:
+                                aud.check_parity("update",
+                                                 (new_w, new_s), ref_u)
+                            self._write_back(entry, new_w, new_s,
+                                             state_nds, frozen_nds, aux)
+                    _lens.mem_sample("compiled_step")
+        finally:
+            if aud is not None:
+                aud.sweep()
         self.compiled_steps += 1
         _tmetrics.trainer_compiled_step(len(entry["trainable"]))
         out_arrays = [NDArray(v, ctx=ctx) for v in outs]
@@ -664,6 +765,19 @@ class CompiledStep(object):
             for n, nd in zip(entry["frozen_names"], frozen_nds):
                 if n in aux:
                     nd._write(aux[n])
+
+def _donated_nds(train_nds, state_nds):
+    """The NDArrays whose buffers a dispatch donates (program positions
+    0/1: train_vals + state_vals).  Poisoned by contract even where
+    ``_donation_supported()`` is False — CPU CI must catch the
+    read-after-donate that only real TPUs would corrupt.  Takes the
+    arrays _gather already resolved (re-walking the param store per
+    dispatch was measurable against the < 2% auditor budget)."""
+    nds = list(train_nds)
+    for bucket in state_nds:
+        for arrs in bucket:
+            nds.extend(arrs)
+    return nds
 
 
 def _as_nd(a):

@@ -280,7 +280,6 @@ class PipelineTrainer(object):
         pre_blk, post_blk, loss_blk = self.pre, self.post, self.loss
         stage_fn = self._stage_fn()
         n_micro = self.num_microbatches
-        lr = self.learning_rate
 
         def objective(state, x, y):
             from ..ndarray import NDArray
@@ -293,7 +292,11 @@ class PipelineTrainer(object):
             per = loss_blk(NDArray(out), NDArray(y))
             return jnp.mean(per._read())
 
-        def step(state, x, y):
+        # lr rides as a traced OPERAND (GL305): baking self.learning_rate
+        # here would silently pin the schedule to its _build_jit-time
+        # value — the exact constant-freeze the whole-step compiled path
+        # (step_compile.py) already avoids for lr/wd/rescale
+        def step(state, x, y, lr):
             loss, grads = jax.value_and_grad(objective)(state, x, y)
             new_state = jax.tree.map(lambda p, g: p - lr * g, state, grads)
             return new_state, loss
@@ -302,7 +305,7 @@ class PipelineTrainer(object):
                      "pre": {n: repl for n in self._state["pre"]},
                      "post": {n: repl for n in self._state["post"]}}
         self._jit = jax.jit(step,
-                            in_shardings=(shardings, repl, repl),
+                            in_shardings=(shardings, repl, repl, repl),
                             out_shardings=(shardings, repl),
                             donate_argnums=(0,))
 
@@ -317,7 +320,9 @@ class PipelineTrainer(object):
             self._gather(NDArray(x))
             self._build_jit()
         with use_mesh(self.mesh):
-            self._state, loss = self._jit(self._state, x, y)
+            self._state, loss = self._jit(
+                self._state, x, y,
+                jnp.asarray(self.learning_rate, jnp.float32))
         return loss
 
     def sync_params(self):

@@ -658,6 +658,39 @@ def trainer_compiled_fallback(reason):
                       ("reason",)).inc(reason=reason)
 
 
+def step_retrace(reason):
+    """One compiled-step guard miss, labeled by WHICH guard-key
+    component churned (graftguard diff: input-sig / param-meta /
+    optimizer-sig / …, or the structural miss reason) — the signal that
+    separates 'new shape showed up once' from a retrace storm."""
+    if not enabled():
+        return
+    _REGISTRY.counter("graft_step_retraces_total",
+                      "Compiled-step guard misses by churned guard-key "
+                      "component", ("reason",)).inc(reason=reason)
+
+
+def step_guard_entries(n):
+    """Live compiled-step guard-cache population (entries + ineligible
+    markers) — a monotonically climbing gauge is the retrace-storm
+    shape."""
+    if not enabled():
+        return
+    _REGISTRY.gauge("graft_step_guard_entries",
+                    "Entries held in the compiled-step guard "
+                    "cache").set(n)
+
+
+def step_retrace_storm():
+    """One EH301 retrace-storm report (graftguard: >= 3 guard misses in
+    an 8-call window with the churned component named)."""
+    if not enabled():
+        return
+    _REGISTRY.counter("graft_step_retrace_storms_total",
+                      "EH301 retrace storms reported by the compile "
+                      "auditor").inc()
+
+
 # -- graftlens: per-step wall-time attribution --------------------------------
 
 

@@ -64,6 +64,17 @@
 #    bench_eager --smoke (tier 3) additionally gates the
 #    compiled_step_latency_ratio (compiled steady-state <= 0.8x the
 #    bucketed-eager step on the 64-param dist_sync bench) in BENCH JSON.
+# 11. graftguard smoke — analysis.compile_safety --selftest forces every
+#    GL30x fixture (plus its clean twin) through the compile-safety
+#    linter and every EH30x diagnostic through the real CompiledStep
+#    paths: an EH301 retrace storm that must name the churned guard-key
+#    component, an EH302 donated-buffer read-after-dispatch raising with
+#    both stacks, an EH303 constant-bake drift under an unchanged guard
+#    key, and an EH304 compiled-vs-eager ULP sentinel; graftlint --all
+#    (tier 1) also runs the GL3xx pass over the package sources and the
+#    op registry; bench_eager --smoke (tier 3) additionally reports
+#    compile_check_overhead_pct (auditor armed, zero findings) against
+#    its < 2% budget in BENCH JSON.
 #
 # Usage: tools/run_lint.sh [report.json]
 set -uo pipefail
@@ -94,5 +105,8 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     || exit $?
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m incubator_mxnet_tpu.gluon.step_compile --selftest \
+    || exit $?
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m incubator_mxnet_tpu.analysis.compile_safety --selftest \
     || exit $?
 exec python -m incubator_mxnet_tpu.telemetry --selftest
