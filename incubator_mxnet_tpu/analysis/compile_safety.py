@@ -1410,9 +1410,19 @@ class StepAuditor(object):
                        ", ".join("%s x%d" % (k, counts[k])
                                  for k in sorted(counts)),
                        detail or "<no detail>"))
+        # graftxray: the retraces re-ran HLO cost analysis — name what
+        # actually got more expensive, not just which guard churned
+        try:
+            from ..telemetry import xray as _xray_mod
+            cost_growth = _xray_mod.cost_regressions()
+        except Exception:
+            cost_growth = ""
+        if cost_growth:
+            msg += " — cost growth since previous trace: " + cost_growth
         self.storms += 1
         self._miss_log = []     # re-arm: one report per storm burst
-        _journal("EH301", msg, component=top, detail=detail)
+        _journal("EH301", msg, component=top, detail=detail,
+                 cost_growth=cost_growth or None)
         try:
             from ..telemetry import metrics as _m
             _m.step_retrace_storm()

@@ -96,6 +96,7 @@ from ..telemetry import blackbox as _blackbox
 from ..telemetry import lens as _lens
 from ..telemetry import metrics as _tmetrics
 from ..telemetry import tracing as _ttracing
+from ..telemetry import xray as _xray
 from .block import HybridBlock, _flatten, _regroup, _fmt_key, \
     _install_first_touch
 
@@ -376,7 +377,13 @@ class CompiledStep(object):
                 "indices": tuple(b.indices), "kind": b.kind,
                 "arity": arity, "has_state": has_state,
                 "shapes": shapes,
-                "apply": opt.fused_formula_applier(b.kind, cfg, has_state),
+                # nests inside xray:update[k] at the call sites; the
+                # hyphen spelling keeps it OUT of phase attribution
+                # (which keys on "xray:" tokens) while the raw trace
+                # still names the formula kind
+                "apply": opt.fused_formula_applier(
+                    b.kind, cfg, has_state,
+                    scope="xray-apply-%s" % b.kind),
             })
 
         flat_args, in_fmt = _flatten(args, "input")
@@ -401,8 +408,13 @@ class CompiledStep(object):
         fwd_bwd = self._make_fwd_bwd(entry, raw_fwd)
         donate = (0, 1) if _donation_supported() else ()
         kv = tr._kvstore_obj
+        # programs carry stable __name__s so the XLA module names
+        # ("jit_gstep_one", …) are joinable against graftxray's program
+        # registry and a profiler trace's hlo_module column
+        entry["aot"] = {}
         if kv is None:
             one = self._make_one_program(entry, fwd_bwd)
+            one.__name__ = "gstep_one"
             entry["one"] = jax.jit(one, donate_argnums=donate)
             entry["fwd_bwd"] = entry["update"] = None
             # un-jitted twin for the EH304 divergence sentinel: same
@@ -411,13 +423,16 @@ class CompiledStep(object):
             entry["fwd_bwd_raw"] = entry["update_raw"] = None
         else:
             update = self._make_update_program(entry)
+            update.__name__ = "gstep_update"
+
+            def gstep_fwd_bwd(tv, fv, iv, rng):
+                return fwd_bwd(tv, fv, iv, rng, True)
+
             entry["one"] = None
-            entry["fwd_bwd"] = jax.jit(
-                lambda tv, fv, iv, rng: fwd_bwd(tv, fv, iv, rng, True))
+            entry["fwd_bwd"] = jax.jit(gstep_fwd_bwd)
             entry["update"] = jax.jit(update, donate_argnums=donate)
             entry["one_raw"] = None
-            entry["fwd_bwd_raw"] = \
-                lambda tv, fv, iv, rng: fwd_bwd(tv, fv, iv, rng, True)
+            entry["fwd_bwd_raw"] = gstep_fwd_bwd
             entry["update_raw"] = update
 
         # dry abstract trace NOW (jax.eval_shape: no compile, no FLOPs):
@@ -495,12 +510,17 @@ class CompiledStep(object):
                                else in_fmt)
             if not isinstance(args, list):
                 args = [args]
-            with random_state.use_key(rng):
-                with autograd._scope(recording=False, training=True):
-                    with block._trace_params(shadows):
-                        out = block.hybrid_forward_dispatch(*args)
-                        if loss is not None:
-                            out = loss(out, label_nd)
+            # graftxray phase marker: every op staged by the forward
+            # (and therefore its vjp RESIDUALS' producers) carries
+            # "xray:forward" in its HLO op_name metadata — the profiler
+            # attribution joins on it (telemetry/xray.py)
+            with jax.named_scope("xray:forward"):
+                with random_state.use_key(rng):
+                    with autograd._scope(recording=False, training=True):
+                        with block._trace_params(shadows):
+                            out = block.hybrid_forward_dispatch(*args)
+                            if loss is not None:
+                                out = loss(out, label_nd)
             flat_out, fmt = _flatten(out, "output")
             # graftlint: disable=GL304 -- trace-time output-fmt memo, written once per trace
             fmt_cell["fmt"] = fmt
@@ -525,15 +545,20 @@ class CompiledStep(object):
             outs, vjp_fn, aux = jax.vjp(
                 lambda tv: raw_fwd(tv, frozen_vals, input_vals, rng),
                 tuple(train_vals), has_aux=True)
-            # seed exactly as loss.backward() seeds a bare head
-            cts = tuple(autograd.head_seed(o) for o in outs)
-            (grads,) = vjp_fn(cts)
-            if not flat_mode:
-                return outs, aux, grads
-            flats = tuple(
-                _engine.flatten_arrays(
-                    tuple(grads[tpos[i]] for i in spec["indices"]))
-                for spec in bspecs)
+            # graftxray: ops staged by the vjp application (the whole
+            # backward sweep + head seeding + flat packing) are tagged
+            # "xray:backward"; the vjp's forward ops already carry
+            # "xray:forward" from raw_fwd
+            with jax.named_scope("xray:backward"):
+                # seed exactly as loss.backward() seeds a bare head
+                cts = tuple(autograd.head_seed(o) for o in outs)
+                (grads,) = vjp_fn(cts)
+                if not flat_mode:
+                    return outs, aux, grads
+                flats = tuple(
+                    _engine.flatten_arrays(
+                        tuple(grads[tpos[i]] for i in spec["indices"]))
+                    for spec in bspecs)
             return outs, aux, flats
 
         return fwd_bwd
@@ -552,10 +577,11 @@ class CompiledStep(object):
             new_w = list(train_vals)
             new_s = []
             for k, spec in enumerate(bspecs):
-                ws = tuple(train_vals[tpos[i]] for i in spec["indices"])
-                gs = tuple(grads[tpos[i]] for i in spec["indices"])
-                nw, ns = spec["apply"](ws, gs, state_vals[k],
-                                       lrs[k], wds[k], rescale)
+                with jax.named_scope("xray:update[%d]" % k):
+                    ws = tuple(train_vals[tpos[i]] for i in spec["indices"])
+                    gs = tuple(grads[tpos[i]] for i in spec["indices"])
+                    nw, ns = spec["apply"](ws, gs, state_vals[k],
+                                           lrs[k], wds[k], rescale)
                 for pos, i in enumerate(spec["indices"]):
                     new_w[tpos[i]] = nw[pos]
                 new_s.append(ns)
@@ -575,10 +601,11 @@ class CompiledStep(object):
             new_w = list(train_vals)
             new_s = []
             for k, spec in enumerate(bspecs):
-                ws = tuple(train_vals[tpos[i]] for i in spec["indices"])
-                gs = _engine.unflatten(flats[k], spec["shapes"])
-                nw, ns = spec["apply"](ws, gs, state_vals[k],
-                                       lrs[k], wds[k], rescale)
+                with jax.named_scope("xray:update[%d]" % k):
+                    ws = tuple(train_vals[tpos[i]] for i in spec["indices"])
+                    gs = _engine.unflatten(flats[k], spec["shapes"])
+                    nw, ns = spec["apply"](ws, gs, state_vals[k],
+                                           lrs[k], wds[k], rescale)
                 for pos, i in enumerate(spec["indices"]):
                     new_w[tpos[i]] = nw[pos]
                 new_s.append(ns)
@@ -619,6 +646,34 @@ class CompiledStep(object):
                                     for arrs in nds))
         return (train_vals, frozen_vals, input_vals, frozen_nds,
                 state_nds, tuple(state_vals), train_nds)
+
+    def _aot(self, entry, kind, cargs):
+        """Resolve the executable for program ``kind`` ("one",
+        "fwd_bwd", "update").  The first dispatch AOT-lowers and
+        compiles the jit wrapper (``.lower(*args).compile()``) — the
+        same trace+compile the first jit call would have paid, done
+        explicitly so the :class:`jax.stages.Compiled` handle exists:
+        graftxray reads its HLO text (phase scope maps) and
+        cost/memory analysis (``xray.note_program`` → blackbox
+        ``xray_cost`` / retrace ``xray_cost_diff`` journals).  lr/wd/
+        rescale ride as weak-typed scalar OPERANDS, so later calls with
+        different values reuse the same executable (probed; the
+        selftest's set_learning_rate leg asserts it).  Any AOT failure
+        pins the plain jit wrapper instead — dispatch never breaks for
+        want of introspection."""
+        c = entry["aot"].get(kind)
+        if c is None:
+            jfn = entry[kind]
+            try:
+                c = jfn.lower(*cargs).compile()
+                _xray.note_program(
+                    "gstep_" + kind, c,
+                    label="%s/%dp/%db" % (kind, len(entry["trainable"]),
+                                          len(entry["bspecs"])))
+            except Exception:
+                c = jfn
+            entry["aot"][kind] = c
+        return c
 
     def _dispatch(self, entry, args, batch_size):
         tr = self._trainer
@@ -664,6 +719,12 @@ class CompiledStep(object):
                           for k in entry["bake_kinds"]))
             sentinel = aud.sentinel_due()
 
+        # graftxray capture window: one memoized env read when idle;
+        # when a session is due (pending trigger / GRAFT_XRAY_EVERY) it
+        # brackets the next GRAFT_XRAY_STEPS dispatches with
+        # jax.profiler and attributes device ops to the xray:* phases
+        new_w = None
+        _xray.dispatch_begin()
         try:
             with _blackbox.step_journal("trainer", batch_size=batch_size,
                                         fused=True, overlapped=False,
@@ -688,10 +749,11 @@ class CompiledStep(object):
                                 aud.poison(_donated_nds(train_nds,
                                                         state_nds),
                                            "one")
+                            cargs = (train_vals, state_vals, frozen_vals,
+                                     input_vals, rng, lrs, wds, rescale)
+                            one_c = self._aot(entry, "one", cargs)
                             t0 = time.perf_counter()
-                            outs, aux, new_w, new_s = entry["one"](
-                                train_vals, state_vals, frozen_vals,
-                                input_vals, rng, lrs, wds, rescale)
+                            outs, aux, new_w, new_s = one_c(*cargs)
                             _lens.device_async(
                                 [new_w[-1] if new_w else outs[0]], t0)
                             if ref is not None:
@@ -702,9 +764,11 @@ class CompiledStep(object):
                                              state_nds, frozen_nds, aux)
                     else:
                         with _ttracing.phase_span("fwd"):
+                            cargs = (train_vals, frozen_vals,
+                                     input_vals, rng)
+                            fb_c = self._aot(entry, "fwd_bwd", cargs)
                             t0 = time.perf_counter()
-                            outs, aux, flats = entry["fwd_bwd"](
-                                train_vals, frozen_vals, input_vals, rng)
+                            outs, aux, flats = fb_c(*cargs)
                             _lens.device_async([flats[-1]], t0)
                         with _ttracing.phase_span("kvstore"):
                             # cross-worker reduce AT the program
@@ -730,10 +794,11 @@ class CompiledStep(object):
                                 aud.poison(_donated_nds(train_nds,
                                                         state_nds),
                                            "update")
+                            cargs = (train_vals, state_vals, reduced,
+                                     lrs, wds, rescale)
+                            up_c = self._aot(entry, "update", cargs)
                             t1 = time.perf_counter()
-                            new_w, new_s = entry["update"](
-                                train_vals, state_vals, reduced,
-                                lrs, wds, rescale)
+                            new_w, new_s = up_c(*cargs)
                             _lens.device_async(
                                 [new_w[-1] if new_w else reduced[-1]],
                                 t1)
@@ -746,6 +811,12 @@ class CompiledStep(object):
         finally:
             if aud is not None:
                 aud.sweep()
+            # closes an open capture session once it spans
+            # GRAFT_XRAY_STEPS dispatches (blocks on the new weights so
+            # the device work lands inside the trace); one env read when
+            # idle, and an errored dispatch still counts so a session
+            # can't be left open across an exception
+            _xray.dispatch_end(sync=new_w)
         self.compiled_steps += 1
         _tmetrics.trainer_compiled_step(len(entry["trainable"]))
         out_arrays = [NDArray(v, ctx=ctx) for v in outs]

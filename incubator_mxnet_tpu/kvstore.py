@@ -47,6 +47,36 @@ def _nd_bytes(arr):
     return n * np.dtype(arr.dtype).itemsize
 
 
+class _NullCtx(object):
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+_NULL_CTX = _NullCtx()
+
+
+def _xray_boundary(label):
+    """graftxray program-boundary marker: when the capture harness is
+    armed, wrap the host side of the reduce in a profiler
+    ``TraceAnnotation`` so a capture shows exactly where program A ends
+    and program B begins (host event — never enters phase attribution,
+    which counts device ops only).  Unarmed cost: one memoized env
+    read."""
+    from .telemetry import xray as _xray
+    if not _xray.armed():
+        return _NULL_CTX
+    try:
+        import jax.profiler as _jprof
+        return _jprof.TraceAnnotation("xray:kvstore:%s" % (label or "reduce"))
+    except Exception:
+        return _NULL_CTX
+
+
 def _wire_bytes(nbytes, compressor):
     """Post-compression size of an ``nbytes`` payload on the wire: 2-bit
     quantization packs 16 elements per float32 word (ref:
@@ -346,7 +376,8 @@ class KVStore(object):
         extra = {"label": label} if label else {}
         with _blackbox.collective("reduce_many", n_keys=len(values),
                                   nbytes=raw, **extra):
-            return self._cross_worker_reduce_many(list(values))
+            with _xray_boundary(label):
+                return self._cross_worker_reduce_many(list(values))
 
     def reduce_many_async(self, values, label=None):
         """Issue the cross-worker reduce of ``values`` WITHOUT waiting

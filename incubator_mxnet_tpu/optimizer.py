@@ -742,11 +742,17 @@ def _fused_config(optimizer, kind):
     raise ValueError("unknown fused kind %r" % kind)
 
 
-def fused_formula_applier(kind, cfg, has_state):
+def fused_formula_applier(kind, cfg, has_state, scope=None):
     """The per-bucket multi-tensor update as a PURE function —
     ``apply(weights, gs, states, lrs, wds, rescale) -> (new_w, new_s)``
     — composable into a LARGER trace (the graftstep whole-step program
     fuses it after ``jax.vjp``'s backward, ``gluon/step_compile.py``).
+
+    ``scope`` (graftxray): an optional ``jax.named_scope`` name wrapped
+    around the formula math so the ops carry it in their HLO op_name
+    metadata (telemetry/xray.py attribution).  Default None emits NO
+    scope — the eager graftfuse constant layout must stay bit-identical
+    to the per-param path, so only the compiled step passes one.
 
     ``lrs``/``wds``/``rescale`` may be python floats (the constant
     layout :func:`_build_fused_program` bakes — bit-identical to the
@@ -808,7 +814,14 @@ def fused_formula_applier(kind, cfg, has_state):
                 new_s.append((m2, v2))
         return tuple(new_w), tuple(new_s)
 
-    return apply
+    if scope is None:
+        return apply
+
+    def scoped_apply(weights, gs, states, lrs, wds, rescale):
+        with jax.named_scope(scope):
+            return apply(weights, gs, states, lrs, wds, rescale)
+
+    return scoped_apply
 
 
 def _build_fused_program(kind, cfg, shapes, flat_mode, has_state,

@@ -212,6 +212,8 @@ class DynamicBatcher(object):
         wait_ms = serve_max_wait_ms() if max_wait_ms is None \
             else max(float(max_wait_ms), 0.0)
         self._max_wait = wait_ms / 1e3
+        self._wait_ms_base = wait_ms    # the configured value the
+        #                                 autotuner relaxes back toward
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._queues = OrderedDict()    # key -> deque[_Request]
@@ -223,6 +225,39 @@ class DynamicBatcher(object):
         self._batch_seq = itertools.count(1)
         self.batches_total = 0
         self.requests_total = 0
+        # graftpulse: the batcher's max-batch / max-wait become live
+        # autotuner targets (weak registration; ~free when GRAFT_AUTOTUNE
+        # is off — the controller's observer returns immediately)
+        try:
+            from ..telemetry import autotune as _autotune
+            _autotune.register_batcher(self)
+        except Exception:
+            pass
+
+    # -- graftpulse live knobs ----------------------------------------------
+    def max_batch(self):
+        return self._max_batch
+
+    def set_max_batch(self, n):
+        """Live resize: takes effect on the next pick — bucket padding
+        follows automatically (``_bucket_for`` caps at the new max, so
+        a grown batch compiles at most one new bucket size)."""
+        with self._cv:
+            self._max_batch = max(int(n), 1)
+            self._cv.notify()
+
+    def max_wait_ms(self):
+        return self._max_wait * 1e3
+
+    def configured_max_wait_ms(self):
+        """The construction-time max-wait — the ceiling the autotuner
+        relaxes a squeezed wait back toward."""
+        return self._wait_ms_base
+
+    def set_max_wait_ms(self, ms):
+        with self._cv:
+            self._max_wait = max(float(ms), 0.0) / 1e3
+            self._cv.notify()
 
     # -- submission ----------------------------------------------------------
     def submit(self, model, x, deadline_ms=None):

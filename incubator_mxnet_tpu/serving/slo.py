@@ -32,7 +32,8 @@ from collections import deque
 from ..telemetry import metrics as _tmetrics
 
 __all__ = ["COMPONENTS", "decompose", "record_request", "record_batch",
-           "requests", "quantiles", "summary", "reset", "ring_size"]
+           "requests", "quantiles", "component_quantile", "summary",
+           "reset", "ring_size"]
 
 COMPONENTS = ("queue_wait", "batch_assembly", "device_compute", "host_io")
 
@@ -110,6 +111,22 @@ def quantiles(records=None):
         walls = [r["wall_s"] for r in records if r["ok"]]
     walls.sort()
     return _quantile(walls, 0.50), _quantile(walls, 0.99)
+
+
+def component_quantile(component, q=0.99, records=None):
+    """Quantile of ONE latency component over the ring's ok requests —
+    e.g. ``component_quantile("queue_wait", 0.99)`` is the signal the
+    graftpulse serving knob steers on (telemetry/autotune.py).  None on
+    an empty ring or unknown component."""
+    if component not in COMPONENTS:
+        return None
+    if records is None:
+        with _lock:
+            vals = [r["components"][component] for r in _ring if r["ok"]]
+    else:
+        vals = [r["components"][component] for r in records if r["ok"]]
+    vals.sort()
+    return _quantile(vals, q)
 
 
 def summary(records=None):

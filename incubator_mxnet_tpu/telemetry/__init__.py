@@ -54,13 +54,15 @@ from . import tracing
 from . import blackbox
 from . import watchdog
 from . import aggregate
+from . import xray
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       compact_snapshot, enabled, parse_prometheus_text,
                       registry, set_enabled, write_snapshot)
 from .tracing import phase_span
 
 __all__ = ["metrics", "lens", "tracing", "blackbox", "watchdog",
-           "aggregate", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "aggregate", "xray",
+           "Counter", "Gauge", "Histogram", "MetricsRegistry",
            "registry", "enabled", "set_enabled", "parse_prometheus_text",
            "compact_snapshot", "write_snapshot", "phase_span"]
 

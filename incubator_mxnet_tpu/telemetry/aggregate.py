@@ -591,37 +591,19 @@ def analyze(paths, merged_out=None):
 # graftpulse: profiler-trace ingestion (the async-ledger fallback)
 # ---------------------------------------------------------------------------
 
-def _merge_intervals(ivs):
-    """Union of (t0, t1) intervals: (merged list, total covered)."""
-    if not ivs:
-        return [], 0.0
-    ivs = sorted(ivs)
-    out = [list(ivs[0])]
-    for t0, t1 in ivs[1:]:
-        if t0 <= out[-1][1]:
-            out[-1][1] = max(out[-1][1], t1)
-        else:
-            out.append([t0, t1])
-    return out, sum(t1 - t0 for t0, t1 in out)
+# the trace-parsing core (interval union, device-event detection, the
+# per-step row convention) is SHARED with the online graftxray capture
+# path — one parser, online + offline (telemetry/xray.py); the private
+# names stay as aliases for the existing callers and tests
+from . import xray as _xray
 
-
-_DEVICE_PID_HINTS = ("tpu", "gpu", "/device:", "accelerator")
+_merge_intervals = _xray.merge_intervals
+_DEVICE_PID_HINTS = _xray.DEVICE_PID_HINTS
 
 
 def _is_device_event(ev, device_pids):
-    """Does this complete ("X") span represent DEVICE execution?  Three
-    signals, any one suffices: our own sync-mode spans carry
-    ``args.device_time``; XLA profiler traces put device ops on
-    device-named process tracks; a ``cat`` naming the device."""
-    args = ev.get("args") or {}
-    if args.get("device_time"):
-        return True
-    if ev.get("pid") in device_pids:
-        return True
-    pid = str(ev.get("pid", "")).lower()
-    cat = str(ev.get("cat", "")).lower()
-    return any(h in pid for h in _DEVICE_PID_HINTS) \
-        or "device" in cat
+    """Shared-core device-span detection (see xray.is_device_event)."""
+    return _xray.is_device_event(ev, device_pids)
 
 
 def ingest_xla(path_or_doc):
@@ -634,96 +616,18 @@ def ingest_xla(path_or_doc):
     pool into one unattributed window).  Step windows follow the live
     lens convention — previous step's window end to this step's — so
     ``busy_s + idle_s == wall_s`` holds exactly per row, same contract
-    as the online ledger.  Returns the report dict (``steps`` rows +
+    as the online ledger.  The grouping, the union and the row
+    convention are the graftxray shared core (``xray.step_spans`` /
+    ``xray.step_rows``) — the online capture parser and this offline
+    CLI cannot drift apart.  Returns the report dict (``steps`` rows +
     ``total``); CLI: ``telemetry --ingest-xla PATH [--json]``."""
-    if isinstance(path_or_doc, str):
-        with open(path_or_doc) as f:
-            doc = json.load(f)
-    else:
-        doc = path_or_doc
-    events = doc.get("traceEvents") if isinstance(doc, dict) else doc
-    if not isinstance(events, list):
-        raise ValueError("not a chrome trace: no traceEvents list")
-    # device-named process tracks from the metadata stream
-    device_pids = set()
-    for ev in events:
-        if ev.get("ph") == "M" and ev.get("name") == "process_name":
-            pname = str((ev.get("args") or {}).get("name", "")).lower()
-            if any(h in pname for h in _DEVICE_PID_HINTS):
-                device_pids.add(ev.get("pid"))
-    by_step = {}
-    n_device = 0
-    for ev in events:
-        if ev.get("ph") != "X" or "dur" not in ev:
-            continue
-        if not _is_device_event(ev, device_pids):
-            continue
-        n_device += 1
-        t0 = float(ev["ts"]) * 1e-6
-        t1 = t0 + float(ev["dur"]) * 1e-6
-        step = (ev.get("args") or {}).get("step")
-        if step is not None:
-            try:        # externally produced traces stamp steps as
-                step = int(step)    # strings — normalize so "7" and 7
-            except (TypeError, ValueError):     # pool together
-                pass
-        by_step.setdefault(step, []).append((t0, t1))
-    nonmono = []
-
-    def _row(step, w0):
-        merged, busy = _merge_intervals(by_step[step])
-        if w0 is None:
-            w0 = merged[0][0]
-        w1 = merged[-1][1]
-        if w1 < w0:
-            # id order disagrees with time order (a restarted step
-            # counter, a merged multi-capture): the chained window start
-            # sits past every span of this step, so wall/busy clamp to
-            # 0 — real device time vanishes from the row.  Surface it
-            # instead of zeroing silently
-            nonmono.append(step)
-        wall = max(w1 - w0, 0.0)
-        busy = min(busy, wall)
-        return {"step": step, "wall_s": round(wall, 6),
-                "busy_s": round(busy, 6),
-                "idle_s": round(wall - busy, 6),
-                "busy_fraction": round(busy / wall, 4) if wall > 0
-                else 0.0,
-                "spans": len(by_step[step])}, w1
-
-    rows = []
-    # non-numeric stamps sort after numeric ones (never against them —
-    # a mixed int/str sort would TypeError)
-    stamped = sorted((s for s in by_step if s is not None),
-                     key=lambda s: (1, str(s)) if isinstance(s, str)
-                     else (0, s))
-    prev_end = None
-    for step in stamped:
-        row, prev_end = _row(step, prev_end)
-        rows.append(row)
-    if None in by_step:
-        rows.append(_row(None, None)[0])
-    # the total is the UNION over every device span, not the sum of row
-    # windows: the pooled unattributed (step None) row's window overlaps
-    # the stamped rows' chained windows (and overlapping spans across
-    # rows would double-count busy) — summing rows can double the wall
-    # and halve the headline busy_fraction.  For a clean monotonic
-    # all-stamped trace the chained row walls telescope to exactly this
-    if by_step:
-        merged, total_busy = _merge_intervals(
-            [sp for spans in by_step.values() for sp in spans])
-        total_wall = merged[-1][1] - merged[0][0]
-        total_busy = min(total_busy, total_wall)
-    else:
-        total_wall = total_busy = 0.0
+    events = _xray.load_trace(path_or_doc)
+    by_step, n_device, _dpids = _xray.step_spans(events)
+    rows, nonmono, total = _xray.step_rows(by_step)
     report = {
         "device_events": n_device,
         "steps": rows,
-        "total": {"wall_s": round(total_wall, 6),
-                  "busy_s": round(total_busy, 6),
-                  "idle_s": round(total_wall - total_busy, 6),
-                  "busy_fraction": round(total_busy / total_wall, 4)
-                  if total_wall > 0 else 0.0},
+        "total": total,
         "problems": [] if n_device else [
             "no device-busy spans found (no args.device_time spans, no "
             "device-named process track, no device cat) — was the trace "

@@ -33,6 +33,26 @@ carry-forward), guarded and default-off (``GRAFT_AUTOTUNE``):
   step re-packs (one serial fallback step per re-plan, the documented
   plan-change rail).
 
+* **multi-rank bucket moves are rank-0-decides** — under
+  ``jax.process_count() > 1`` only rank 0's controller moves the bucket
+  knob, and the move rides the dist heartbeat allreduce as one extra
+  int32 slot (``parallel/dist.py propose_bucket_bytes``): every rank —
+  rank 0 included — applies it via
+  :func:`apply_bucket_bytes_broadcast` on the heartbeat where it lands,
+  so all plans re-pack on the same step and the lockstep auditor stays
+  quiet.  Non-zero ranks' tuners are observation-only for this knob.
+
+* **serve p99 queue_wait → batcher max_batch / max_wait** — every
+  ``interval`` serve-batch lens windows the SLO ring's p99
+  ``queue_wait`` is compared against ``GRAFT_AUTOTUNE_SERVE_QW_MS``
+  (default 5 ms): above it the registered
+  :class:`~incubator_mxnet_tpu.serving.DynamicBatcher`'s max-batch
+  doubles (capped at ``GRAFT_AUTOTUNE_MAX_SERVE_BATCH``, default 256),
+  then its max-wait halves (floor 0.5 ms); when the p99 relaxes below a
+  quarter of the bound the squeezed max-wait recovers toward its
+  configured value.  Same cooldown/journaling discipline
+  (``serve_max_batch`` / ``serve_max_wait_ms`` decisions).
+
 * **straggler lateness → bucket order** — :func:`feed_straggler_table`
   accepts ``telemetry/aggregate.py``'s straggler rows (or any
   ``{"label", "lateness_s"}`` list) and feeds each named bucket's
@@ -69,7 +89,8 @@ from . import lens as _lens
 from . import metrics as _metrics
 
 __all__ = ["enabled", "set_enabled", "Autotuner", "controller",
-           "register_loader", "register_trainer", "feed_straggler_table",
+           "register_loader", "register_trainer", "register_batcher",
+           "feed_straggler_table", "apply_bucket_bytes_broadcast",
            "decisions", "reset", "selftest", "main"]
 
 _enabled_override = None
@@ -115,7 +136,7 @@ class Autotuner(object):
     def __init__(self, interval=None, cooldown=None, data_wait_bound=None,
                  comm_hidden_bound=None, max_workers=None,
                  min_bucket_bytes=None, max_bucket_bytes=None,
-                 max_prefetch=None):
+                 max_prefetch=None, serve_qw_ms=None, max_serve_batch=None):
         self.interval = interval if interval is not None \
             else _env_int("GRAFT_AUTOTUNE_INTERVAL", 8)
         self.cooldown = cooldown if cooldown is not None \
@@ -135,9 +156,17 @@ class Autotuner(object):
             else _env_int("GRAFT_AUTOTUNE_MAX_BUCKET_BYTES", 64 << 20)
         self.max_prefetch = max_prefetch if max_prefetch is not None \
             else _env_int("GRAFT_AUTOTUNE_MAX_PREFETCH", 8)
+        self.serve_qw_bound = (serve_qw_ms if serve_qw_ms is not None
+                               else _env_float("GRAFT_AUTOTUNE_SERVE_QW_MS",
+                                               5.0)) / 1e3
+        self.max_serve_batch = max_serve_batch \
+            if max_serve_batch is not None \
+            else _env_int("GRAFT_AUTOTUNE_MAX_SERVE_BATCH", 256)
         self._lock = threading.Lock()
         self._loaders = []          # weakrefs, registration order
         self._trainers = []         # weakrefs
+        self._batchers = []         # weakrefs (serving knob targets)
+        self._serve_seen = 0        # serve_batch windows since last eval
         self._window = []           # lens records of the open window
         self._cooldowns = {}        # knob -> windows remaining
         self._hidden_at_move = None  # hidden ratio WHEN the last bucket
@@ -159,6 +188,12 @@ class Autotuner(object):
             if not any(r() is trainer for r in self._trainers):
                 self._trainers.append(weakref.ref(trainer))
 
+    def attach_batcher(self, batcher):
+        with self._lock:
+            self._batchers = [r for r in self._batchers if r() is not None]
+            if not any(r() is batcher for r in self._batchers):
+                self._batchers.append(weakref.ref(batcher))
+
     def _live(self, refs):
         return [r() for r in refs if r() is not None]
 
@@ -168,11 +203,21 @@ class Autotuner(object):
         return: the default path stays bit-identical."""
         if not enabled():
             return
+        if rec.get("origin") == "serve_batch":
+            # serving windows feed their OWN knob (max_batch/max_wait
+            # from the SLO ring's p99 queue_wait) on their own cadence —
+            # mixing them into the train decision window would dilute
+            # data_frac while the DataLoader starves
+            with self._lock:
+                self._serve_seen += 1
+                if self._serve_seen >= self.interval:
+                    self._serve_seen = 0
+                    self._tune_serving_locked()
+            return
         if rec.get("origin") not in _TRAIN_ORIGINS:
-            # the lens streams EVERY window — serving batches
-            # (origin "serve_batch"), ad-hoc step_end callers — and a
-            # train+serve process would fill decision windows with
-            # serving records (data_wait 0, nonzero wall), diluting
+            # the lens streams EVERY window — ad-hoc step_end callers —
+            # and a train+serve process would fill decision windows with
+            # foreign records (data_wait 0, nonzero wall), diluting
             # data_frac below the bound while the DataLoader starves.
             # Decide on train-step windows only
             return
@@ -299,15 +344,17 @@ class Autotuner(object):
         try:
             import jax
             multi_rank = jax.process_count() > 1
+            my_rank = jax.process_index() if multi_rank else 0
         except Exception:
-            multi_rank = False
-        if multi_rank:
+            multi_rank, my_rank = False, 0
+        if multi_rank and my_rank != 0:
             # per-rank hill-climb moves diverge the collective stream:
             # one rank shrinking while a peer holds re-packs DIFFERENT
             # bucket plans, the mispaired wire hangs, and the lockstep
-            # auditor fires on a healthy job.  Bucket moves must stay
-            # rank-consistent (ROADMAP); until a move can ride a
-            # collective agreement step this knob is single-process only
+            # auditor fires on a healthy job.  Under multi-rank the knob
+            # is therefore rank-0-decides: non-zero ranks observe only,
+            # and apply rank 0's move when the heartbeat broadcast lands
+            # (:func:`apply_bucket_bytes_broadcast`)
             return
         from ..overlap import DEFAULT_BUCKET_BYTES
         try:
@@ -326,11 +373,91 @@ class Autotuner(object):
                       min(self.max_bucket_bytes, new))
             if new == cur:
                 return
+        if multi_rank:
+            # rank 0: PARK the move in the dist mailbox — it takes
+            # effect on every rank (this one included) only when the
+            # next heartbeat allreduce carries it, so all plans re-pack
+            # on the same step.  The decision is journaled NOW (starting
+            # the cooldown); the landing journals separately as
+            # bucket_bytes_broadcast on each rank.
+            try:
+                from ..parallel import dist as _dist
+                _dist.propose_bucket_bytes(new)
+            except Exception:
+                return
+            self._hidden_at_move = hidden
+            self._bucket_move_pending = True
+            self._decide("comm_hidden", "bucket_bytes", cur, new,
+                         comm_hidden_ratio=round(hidden, 4),
+                         broadcast="proposed")
+            return
         os.environ["GRAFT_BUCKET_BYTES"] = str(new)
         self._hidden_at_move = hidden
         self._bucket_move_pending = True
         self._decide("comm_hidden", "bucket_bytes", cur, new,
                      comm_hidden_ratio=round(hidden, 4))
+
+    def _tune_serving_locked(self):
+        """The serving knob, evaluated every ``interval`` serve-batch
+        lens windows (called under ``self._lock``).  Signal: the SLO
+        ring's p99 ``queue_wait`` (``slo.component_quantile``).  Above
+        ``GRAFT_AUTOTUNE_SERVE_QW_MS``: grow the batcher's max_batch
+        (doubling, capped at ``GRAFT_AUTOTUNE_MAX_SERVE_BATCH``); at
+        the cap, halve max-wait instead (floor 0.5 ms) — a fuller batch
+        drains the queue, a shorter window stops feeding it.  Below a
+        quarter of the bound: relax a squeezed max-wait back toward its
+        configured value (never past it).  One shared cooldown, ticked
+        on this cadence so a serve-only process still cools down."""
+        cd = self._cooldowns.get("serving")
+        if cd is not None:
+            cd -= 1
+            if cd > 0:
+                self._cooldowns["serving"] = cd
+                return
+            self._cooldowns.pop("serving", None)
+        try:
+            from ..serving import slo as _slo
+            p99 = _slo.component_quantile("queue_wait", 0.99)
+        except Exception:
+            return
+        if p99 is None:
+            return
+        _metrics.autotune_signal("serve_queue_wait_p99_s", p99)
+        for b in self._live(self._batchers):
+            if p99 > self.serve_qw_bound:
+                old = int(b.max_batch())
+                new = min(self.max_serve_batch, max(1, old * 2))
+                if new > old:
+                    try:
+                        b.set_max_batch(new)
+                    except Exception:
+                        continue
+                    self._decide("serve_queue_wait", "serve_max_batch",
+                                 old, new, p99_s=round(p99, 6))
+                    self._cooldowns["serving"] = self.cooldown
+                    continue
+                oldw = float(b.max_wait_ms())
+                neww = max(0.5, oldw / 2.0)
+                if neww < oldw:
+                    try:
+                        b.set_max_wait_ms(neww)
+                    except Exception:
+                        continue
+                    self._decide("serve_queue_wait", "serve_max_wait_ms",
+                                 oldw, neww, p99_s=round(p99, 6))
+                    self._cooldowns["serving"] = self.cooldown
+            elif p99 < self.serve_qw_bound / 4.0:
+                oldw = float(b.max_wait_ms())
+                base = float(b.configured_max_wait_ms())
+                if oldw < base:
+                    neww = min(base, oldw * 2.0)
+                    try:
+                        b.set_max_wait_ms(neww)
+                    except Exception:
+                        continue
+                    self._decide("serve_queue_wait", "serve_max_wait_ms",
+                                 oldw, neww, p99_s=round(p99, 6))
+                    self._cooldowns["serving"] = self.cooldown
 
     def feed_straggler_table(self, rows):
         """Feed cross-rank straggler lateness (``aggregate.py`` rows, or
@@ -431,11 +558,44 @@ def register_trainer(trainer):
     controller().attach_trainer(trainer)
 
 
+def register_batcher(batcher):
+    """Called by ``serving.DynamicBatcher.__init__``: the batcher's
+    max-batch / max-wait become live serving-knob targets."""
+    controller().attach_batcher(batcher)
+
+
 def feed_straggler_table(rows):
     """Module-level convenience over :meth:`Autotuner.feed_straggler_table`
     (e.g. piping ``telemetry --analyze --json``'s ``stragglers`` rows
     back into a live job)."""
     return controller().feed_straggler_table(rows)
+
+
+def apply_bucket_bytes_broadcast(nbytes):
+    """Apply a rank-0 bucket-bytes move delivered by the dist heartbeat
+    broadcast (``parallel/dist.py _heartbeat_skew``).  EVERY rank — rank
+    0 included — flips ``GRAFT_BUCKET_BYTES`` here, on the heartbeat
+    where the broadcast landed, so all ranks' plan signatures change on
+    the same step and the collective stream stays in lockstep.  Each
+    landing is journaled under target ``bucket_bytes_broadcast``
+    (distinct from rank 0's proposal record).  Returns True when the
+    knob moved."""
+    try:
+        nbytes = int(nbytes)
+    except (TypeError, ValueError):
+        return False
+    if nbytes <= 0:
+        return False
+    old = os.environ.get("GRAFT_BUCKET_BYTES")
+    if old is not None and old.strip() == str(nbytes):
+        return False
+    os.environ["GRAFT_BUCKET_BYTES"] = str(nbytes)
+    _blackbox.record("autotune_decision", signal="comm_hidden",
+                     target="bucket_bytes_broadcast",
+                     old=old, new=nbytes)
+    _metrics.autotune_decision("comm_hidden", "bucket_bytes_broadcast",
+                               old or 0, nbytes)
+    return True
 
 
 def decisions():

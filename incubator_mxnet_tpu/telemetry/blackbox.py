@@ -51,7 +51,7 @@ __all__ = ["enabled", "set_enabled", "record", "events", "stats",
            "workers_seen", "set_rank", "set_clock_offset", "dump",
            "snapshot", "default_path", "validate_dump", "summarize_dump",
            "install_hooks", "configure", "selftest", "SCHEMA",
-           "register_emergency", "unregister_emergency"]
+           "register_emergency", "unregister_emergency", "xray_session"]
 
 SCHEMA = "graft-blackbox/1"
 _DEFAULT_SIZE = 4096
@@ -516,6 +516,19 @@ def step_journal(origin, **fields):
             return _LensOnlyStep(origin, fields)
         return _NULL
     return _StepJournal(origin, fields)
+
+
+def xray_session(reason, steps, phases, **extra):
+    """One graftxray capture session (kind ``xray_capture``): the
+    phase→device-seconds table a compiled-step profiler capture
+    attributed, plus its conservation verdict and top ops — the
+    flight-recorder twin of the ``graft_xray_phase_device_seconds``
+    gauges, so a post-mortem dump carries the last in-program device
+    decomposition alongside the host-side step journals."""
+    if not enabled():
+        return
+    record("xray_capture", reason=reason, steps=steps, phases=phases,
+           **{k: v for k, v in extra.items() if v is not None})
 
 
 # ---------------------------------------------------------------------------

@@ -708,28 +708,162 @@ def test_autotune_grows_the_loader_the_consumer_blocked_on():
         slow.close()
 
 
-def test_autotune_bucket_moves_gated_off_multi_rank(monkeypatch):
+def test_autotune_bucket_moves_rank0_proposes_multi_rank(monkeypatch):
     """Per-rank bucket moves diverge the collective stream (different
-    plans -> mispaired wire -> lockstep fires on a healthy job): the
-    bucket knob must hold still when the process group is > 1."""
+    plans -> mispaired wire -> lockstep fires on a healthy job): under
+    multi-rank the knob is rank-0-decides.  Non-zero ranks observe
+    only; rank 0 never flips the env directly either — it PARKS the
+    move in the dist mailbox for the heartbeat broadcast, and every
+    rank applies it via apply_bucket_bytes_broadcast when it lands."""
     import jax
+
+    from incubator_mxnet_tpu.parallel import dist
     monkeypatch.setenv("GRAFT_BUCKET_BYTES", str(4 << 20))
     monkeypatch.setattr(jax, "process_count", lambda: 2)
     autotune.set_enabled(True)
     try:
+        dist._take_bucket_proposal()        # drain any stale mailbox
+        ps = _build_params(2, prefix="mr")
+        trainer = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01},
+                                kvstore=mx.kv.create("local"))
+        # --- a NON-ZERO rank: fully inert ------------------------------
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
         ctrl = autotune.Autotuner(interval=1, cooldown=0,
                                   comm_hidden_bound=0.6,
                                   min_bucket_bytes=1 << 20,
                                   max_bucket_bytes=16 << 20)
-        ps = _build_params(2, prefix="mr")
-        trainer = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01},
-                                kvstore=mx.kv.create("local"))
         ctrl.attach_trainer(trainer)
         ctrl.on_step(_fake_rec(1, blocked=0.08, inflight=0.10))
         assert os.environ["GRAFT_BUCKET_BYTES"] == str(4 << 20)
         assert ctrl.decisions() == []
+        assert dist._take_bucket_proposal() == 0
+        # --- rank 0: proposes via the mailbox, does NOT flip the env ---
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        ctrl = autotune.Autotuner(interval=1, cooldown=0,
+                                  comm_hidden_bound=0.6,
+                                  min_bucket_bytes=1 << 20,
+                                  max_bucket_bytes=16 << 20)
+        ctrl.attach_trainer(trainer)
+        ctrl.on_step(_fake_rec(1, blocked=0.08, inflight=0.10))
+        assert os.environ["GRAFT_BUCKET_BYTES"] == str(4 << 20)
+        moves = [d for d in ctrl.decisions()
+                 if d["target"] == "bucket_bytes"]
+        assert len(moves) == 1
+        assert moves[0]["old"] == 4 << 20 and moves[0]["new"] == 2 << 20
+        assert moves[0]["broadcast"] == "proposed"
+        assert dist._take_bucket_proposal() == 2 << 20
+        # --- the landing: every rank flips on the SAME heartbeat -------
+        assert autotune.apply_bucket_bytes_broadcast(2 << 20) is True
+        assert os.environ["GRAFT_BUCKET_BYTES"] == str(2 << 20)
+        # idempotent: the same value landing again is a no-op
+        assert autotune.apply_bucket_bytes_broadcast(2 << 20) is False
+        assert autotune.apply_bucket_bytes_broadcast(0) is False
     finally:
         autotune.set_enabled(None)
+
+
+class _FakeBatcher(object):
+    """The five knob methods serving.DynamicBatcher exposes."""
+
+    def __init__(self, max_batch=8, wait_ms=4.0):
+        self._mb = int(max_batch)
+        self._wait = float(wait_ms)
+        self._base = float(wait_ms)
+
+    def max_batch(self):
+        return self._mb
+
+    def set_max_batch(self, n):
+        self._mb = int(n)
+
+    def max_wait_ms(self):
+        return self._wait
+
+    def configured_max_wait_ms(self):
+        return self._base
+
+    def set_max_wait_ms(self, ms):
+        self._wait = float(ms)
+
+
+def _seed_slo_ring(queue_wait_s, n=8):
+    from incubator_mxnet_tpu.serving import slo
+    slo.reset()
+    for _ in range(n):
+        slo.record_request("m", 1, queue_wait_s + 0.002,
+                           {"queue_wait": queue_wait_s,
+                            "batch_assembly": 0.0005,
+                            "device_compute": 0.001,
+                            "host_io": 0.0005}, 4, 8)
+
+
+def test_autotune_serving_knob_grow_cap_squeeze_relax():
+    """The serving knob end-to-end on the SLO ring's p99 queue_wait:
+    a hot queue doubles max_batch; at the cap it halves max-wait; a
+    cold queue relaxes max-wait back toward (never past) the
+    configured value."""
+    from incubator_mxnet_tpu.serving import slo
+    autotune.set_enabled(True)
+    try:
+        ctrl = autotune.Autotuner(interval=1, cooldown=1,
+                                  serve_qw_ms=5.0, max_serve_batch=16)
+        b = _FakeBatcher(max_batch=8, wait_ms=4.0)
+        ctrl.attach_batcher(b)
+        serve = dict(_fake_rec(0, wall=0.02), origin="serve_batch")
+        # hot queue (p99 20ms >> 5ms): grow max_batch 8 -> 16 (the cap)
+        _seed_slo_ring(0.020)
+        ctrl.on_step(serve)
+        assert b.max_batch() == 16
+        assert b.max_wait_ms() == 4.0
+        # still hot, at the cap: halve max-wait instead, 4 -> 2 -> 1
+        ctrl.on_step(serve)
+        assert b.max_batch() == 16 and b.max_wait_ms() == 2.0
+        ctrl.on_step(serve)
+        assert b.max_wait_ms() == 1.0
+        # cold queue (p99 0.5ms < bound/4): relax back toward the
+        # configured 4ms, never past it
+        _seed_slo_ring(0.0005)
+        ctrl.on_step(serve)
+        assert b.max_wait_ms() == 2.0
+        ctrl.on_step(serve)
+        assert b.max_wait_ms() == 4.0
+        ctrl.on_step(serve)
+        assert b.max_wait_ms() == 4.0       # at the configured value
+        targets = [d["target"] for d in ctrl.decisions()]
+        assert targets == ["serve_max_batch", "serve_max_wait_ms",
+                           "serve_max_wait_ms", "serve_max_wait_ms",
+                           "serve_max_wait_ms"]
+        assert all(d["signal"] == "serve_queue_wait"
+                   for d in ctrl.decisions())
+    finally:
+        autotune.set_enabled(None)
+        slo.reset()
+
+
+def test_autotune_serving_cooldown_ticks_on_serve_cadence():
+    """A serve-only process has no train windows: the serving cooldown
+    must still tick (and hold moves back) on the serve-window cadence
+    itself."""
+    from incubator_mxnet_tpu.serving import slo
+    autotune.set_enabled(True)
+    try:
+        ctrl = autotune.Autotuner(interval=1, cooldown=3,
+                                  serve_qw_ms=5.0, max_serve_batch=64)
+        b = _FakeBatcher(max_batch=4, wait_ms=4.0)
+        ctrl.attach_batcher(b)
+        serve = dict(_fake_rec(0, wall=0.02), origin="serve_batch")
+        _seed_slo_ring(0.020)
+        ctrl.on_step(serve)                 # move: 4 -> 8, cooldown 3
+        assert b.max_batch() == 8
+        ctrl.on_step(serve)                 # cooldown holds (3 -> 2)
+        ctrl.on_step(serve)                 # cooldown holds (2 -> 1)
+        assert b.max_batch() == 8
+        ctrl.on_step(serve)                 # expired: 8 -> 16
+        assert b.max_batch() == 16
+        assert len(ctrl.decisions()) == 2
+    finally:
+        autotune.set_enabled(None)
+        slo.reset()
 
 
 def test_autotune_validated_move_does_not_flip_on_later_sag(monkeypatch):

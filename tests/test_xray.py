@@ -1,0 +1,518 @@
+"""graftxray tests (ISSUE 18): scope-map parsing from optimized HLO,
+conservation-exact phase attribution over synthetic profiler traces,
+the ONE shared parser core behind both the online capture path and the
+offline ``--ingest-xla`` CLI, trigger plumbing (slow-step lens observer,
+watchdog trip, explicit request), off-by-default inertness, the
+at-trace-time cost ledger + retrace cost diffing (the EH301 feed), the
+full compiled-window selftest, and the ``--xray`` renderer."""
+import json
+import os
+import time
+import types
+import warnings
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx  # noqa: F401
+from incubator_mxnet_tpu.telemetry import aggregate, blackbox, lens, xray
+
+
+@pytest.fixture
+def fresh_xray(monkeypatch):
+    """Armed, clean harness for one test."""
+    monkeypatch.setenv("GRAFT_XRAY", "1")
+    xray.reset()
+    yield xray
+    xray.reset()
+
+
+@pytest.fixture
+def dark_xray(monkeypatch):
+    """Explicitly DISarmed harness."""
+    monkeypatch.delenv("GRAFT_XRAY", raising=False)
+    xray.reset()
+    yield xray
+    xray.reset()
+
+
+# ---------------------------------------------------------------------------
+# scope maps from optimized HLO
+# ---------------------------------------------------------------------------
+
+_HLO = """\
+HloModule jit_gstep_one, entry_computation_layout={(f32[1,5]{1,0})->f32[1,5]{1,0}}
+
+%fused_computation (p0: f32[1,5]) -> f32[1,5] {
+  %p0 = f32[1,5]{1,0} parameter(0)
+  ROOT %m = f32[1,5]{1,0} multiply(%p0, %p0)
+}
+
+ENTRY %main.42 (param_0: f32[1,5]) -> f32[1,5] {
+  %param_0 = f32[1,5]{1,0} parameter(0)
+  %fusion.1 = f32[1,5]{1,0} fusion(%param_0), kind=kLoop, calls=%fused_computation, metadata={op_name="jit(gstep_one)/jit(main)/xray:forward/mul" source_file="net.py" source_line=7}
+  %loop_add = f32[1,5]{1,0} add(%fusion.1, %fusion.1), metadata={op_name="jit(gstep_one)/jit(main)/xray:update[0]/xray:inner/add"}
+  %copy.9 = f32[1,5]{1,0} copy(%loop_add), metadata={op_name="jit(gstep_one)/jit(main)/convert"}
+  ROOT %sub.3 = f32[1,5]{1,0} subtract(%copy.9, %fusion.1), metadata={op_name="jit(gstep_one)/jit(main)/xray:backward/sub"}
+}
+"""
+
+
+def test_scope_map_from_hlo_parses_fusions_root_and_skips_scopeless():
+    m = xray.scope_map_from_hlo(_HLO)
+    assert m == {
+        "fusion.1": "forward",
+        # nested scopes resolve to the OUTERMOST xray token
+        "loop_add": "update[0]",
+        "sub.3": "backward",
+    }
+    # scope-less ops (copy.9, param_0, the fused-computation body) are
+    # left out — they pool into "unattributed" at attribution time
+    assert "copy.9" not in m and "param_0" not in m and "m" not in m
+
+
+def test_phase_of_first_token_wins_and_hyphen_spelling_is_excluded():
+    assert xray.phase_of(
+        "jit(f)/xray:update[3]/xray:inner/add") == "update[3]"
+    # the optimizer's fused-formula scope is DELIBERATELY spelled with
+    # a hyphen ("xray-apply-sgd") so bucket-grained update[k] phases
+    # stay the unit of attribution — it must NOT parse as a phase
+    assert xray.phase_of("jit(f)/xray-apply-sgd/mul") is None
+    assert xray.phase_of("") is None
+    assert xray.phase_of(None) is None
+
+
+def test_norm_module_strips_jit_prefix_and_uniquifier():
+    assert xray._norm_module("jit_gstep_one.5") == "gstep_one"
+    assert xray._norm_module("jit_gstep_update") == "gstep_update"
+    assert xray._norm_module("gstep_one") == "gstep_one"
+    assert xray._norm_module(None) == ""
+
+
+# ---------------------------------------------------------------------------
+# attribution: the conservation-exact partition
+# ---------------------------------------------------------------------------
+
+def _dev_ev(name, ts_us, dur_us, op=None, module="jit_gstep_one.3",
+            step=None, pid=7):
+    args = {}
+    if op is not None:
+        args["hlo_op"] = op
+    if module is not None:
+        args["hlo_module"] = module
+    if step is not None:
+        args["step"] = step
+    return {"ph": "X", "name": name, "pid": pid, "tid": 1,
+            "ts": ts_us, "dur": dur_us, "args": args}
+
+
+def _meta(pid=7, name="/device:TPU:0 Compute"):
+    return {"ph": "M", "name": "process_name", "pid": pid,
+            "args": {"name": name}}
+
+
+def test_attribute_exact_conservation_with_fractional_us():
+    """Fractional-µs durations (the TPU trace reality) must still sum
+    EXACTLY: durations accumulate as integer nanoseconds, so the phase
+    partition + unattributed == program span is integer equality, not
+    a float tolerance."""
+    scope_maps = {"gstep_one": {"fusion.1": "forward",
+                                "loop_add": "update[0]",
+                                "sub.3": "backward"}}
+    events = [
+        _meta(),
+        _dev_ev("fusion.1", 100.0, 10.3, op="fusion.1", step=0),
+        _dev_ev("sub.3", 111.0, 20.7, op="sub.3", step=0),
+        _dev_ev("loop_add", 132.5, 5.1, op="loop_add", step=1),
+        # scope-less op of a REGISTERED module -> unattributed
+        _dev_ev("copy.9", 138.0, 0.7, op="copy.9", step=1),
+        # op of an UNREGISTERED module -> unattributed
+        _dev_ev("other", 139.0, 3.3, op="whatever",
+                module="jit_warmup.1", step=1),
+        # host event on a non-device pid: excluded entirely
+        {"ph": "X", "name": "python", "pid": 1, "tid": 2,
+         "ts": 100.0, "dur": 500.0, "args": {}},
+    ]
+    rep = xray.attribute(events, scope_maps=scope_maps)
+    assert rep["device_events"] == 5
+    assert set(rep["phases"]) == {"forward", "backward", "update[0]"}
+    assert rep["phases"]["forward"]["device_s"] == pytest.approx(10.3e-6)
+    assert rep["phases"]["backward"]["device_s"] == pytest.approx(20.7e-6)
+    assert rep["phases"]["update[0]"]["device_s"] == pytest.approx(5.1e-6)
+    assert rep["unattributed_s"] == pytest.approx((0.7 + 3.3) * 1e-6)
+    # the conservation contract is EXACT (integer ns), not approx
+    assert rep["conservation_ok"]
+    assert rep["program_device_s"] == (10300 + 20700 + 5100 + 700
+                                       + 3300) * 1e-9
+    # shares partition to 1 over phases + unattributed
+    total_share = sum(p["share"] for p in rep["phases"].values())
+    assert total_share == pytest.approx(1.0 - (4000 / 40100))
+    # true device-side window in the trace timebase
+    assert rep["span"]["t0"] == pytest.approx(100.0e-6)
+    assert rep["span"]["t1"] == pytest.approx((139.0 + 3.3) * 1e-6)
+    # modules roll up by normalized name
+    assert set(rep["modules"]) == {"gstep_one", "warmup"}
+    # the shared ledger produced one row per step stamp
+    steps = [r["step"] for r in rep["ledger"]["steps"]]
+    assert steps == [0, 1]
+    for row in rep["ledger"]["steps"]:
+        assert row["busy_s"] + row["idle_s"] == pytest.approx(
+            row["wall_s"])
+    # top op is the backward sub
+    assert rep["top_ops"][0]["op"] == "sub.3"
+    assert rep["top_ops"][0]["phase"] == "backward"
+
+
+def test_attribute_empty_and_scopeless_traces():
+    rep = xray.attribute([], scope_maps={})
+    assert rep["device_events"] == 0
+    assert rep["phases"] == {}
+    assert rep["conservation_ok"]    # 0 + 0 == 0
+    assert rep["span"] is None
+    # a trace with device ops but NO registered scope maps: everything
+    # pools into unattributed, conservation still exact
+    events = [_meta(), _dev_ev("x", 10.0, 2.5, op="x", step=0)]
+    rep = xray.attribute(events, scope_maps={})
+    assert rep["phases"] == {}
+    assert rep["unattributed_s"] == pytest.approx(2.5e-6)
+    assert rep["conservation_ok"]
+
+
+def test_parse_trace_offline_twin(tmp_path):
+    doc = {"traceEvents": [_meta(),
+                           _dev_ev("f", 5.0, 4.0, op="fusion.1", step=0)]}
+    p = tmp_path / "t.trace.json"
+    p.write_text(json.dumps(doc))
+    rep = xray.parse_trace(str(p),
+                           scope_maps={"gstep_one": {"fusion.1": "fwd"}})
+    assert rep["phases"]["fwd"]["device_s"] == pytest.approx(4.0e-6)
+    assert rep["conservation_ok"]
+
+
+# ---------------------------------------------------------------------------
+# parser unification: ONE shared core behind aggregate.ingest_xla and
+# the online capture sessions
+# ---------------------------------------------------------------------------
+
+def test_parser_core_is_shared_not_cloned():
+    """The offline CLI's parser internals must BE the xray core (same
+    function objects), not a drifting copy — the dedup the refactor
+    promised."""
+    assert aggregate._merge_intervals is xray.merge_intervals
+    assert aggregate._DEVICE_PID_HINTS is xray.DEVICE_PID_HINTS
+
+
+def test_ingest_xla_and_attribute_agree_on_step_rows(tmp_path):
+    """Both paths run the same events through step_spans/step_rows: the
+    per-step device ledger rows must be identical."""
+    events = [_meta(),
+              _dev_ev("a", 10.0, 3.0, op="a", step=0),
+              _dev_ev("b", 14.0, 2.0, op="b", step=0),
+              _dev_ev("c", 17.0, 4.5, op="c", step=1),
+              _dev_ev("d", 30.0, 1.5, op="d")]      # unstamped pool
+    p = tmp_path / "steps.trace.json"
+    p.write_text(json.dumps({"traceEvents": events}))
+    offline = aggregate.ingest_xla(str(p))
+    online = xray.attribute(events, scope_maps={})
+    assert offline["steps"] == online["ledger"]["steps"]
+    assert offline["total"] == online["ledger"]["total"]
+
+
+# ---------------------------------------------------------------------------
+# triggers + capture lifecycle
+# ---------------------------------------------------------------------------
+
+def test_unarmed_harness_is_inert(dark_xray):
+    assert not xray.armed()
+    assert xray.request_capture("manual") is False
+    xray.dispatch_begin()
+    xray.dispatch_end(sync=None)
+    assert xray._dispatch_count[0] == 0      # begin returned pre-count
+    assert xray._pending == []
+    assert not xray.capture_active()
+    assert xray.sessions() == []
+    # the triggered paths stay inert too
+    xray._lens_trigger({"compiled": True, "wall_s": 9.9})
+    assert xray._pending == []
+
+
+def test_request_capture_dedups_and_caps(fresh_xray):
+    assert xray.request_capture("manual") is True
+    assert xray.request_capture("manual") is True    # accepted, deduped
+    assert xray._pending == ["manual"]
+    for i in range(10):
+        xray.request_capture("r%d" % i)
+    assert len(xray._pending) == 4                   # FIFO cap
+
+
+def test_slow_step_lens_trigger(fresh_xray):
+    """≥8 compiled walls build the baseline; one outlier past
+    GRAFT_XRAY_SLOW_X × median requests a one-shot capture."""
+    for _ in range(10):
+        xray._lens_trigger({"compiled": True, "wall_s": 0.01})
+    assert xray._pending == []                       # steady state
+    xray._lens_trigger({"compiled": True, "wall_s": 1.0})
+    assert "slow-step" in xray._pending
+    # eager (non-compiled) outliers never trigger — the capture harness
+    # profiles the compiled step only
+    xray.reset()
+    for _ in range(10):
+        xray._lens_trigger({"compiled": True, "wall_s": 0.01})
+    xray._lens_trigger({"compiled": False, "wall_s": 5.0})
+    assert xray._pending == []
+
+
+def test_slow_step_trigger_needs_baseline(fresh_xray):
+    """The first few walls must not trigger — no median yet."""
+    for w in (0.01, 0.02, 5.0):
+        xray._lens_trigger({"compiled": True, "wall_s": w})
+    assert xray._pending == []
+
+
+def test_watchdog_trip_on_compiled_bracket_requests_capture(
+        fresh_xray, monkeypatch, tmp_path):
+    from incubator_mxnet_tpu.telemetry import watchdog as wdmod
+    monkeypatch.setattr(wdmod._blackbox, "dump",
+                        lambda **kw: str(tmp_path / "dump.json"))
+    wd = wdmod.Watchdog(timeout=1.0, abort=False)
+    entry = {"site": "compiled_step", "since": time.time() - 5.0,
+             "detail": {"compiled": True, "programs": 2},
+             "thread": "MainThread"}
+    wd.trip(entry, 5.0)
+    assert "watchdog:compiled_step" in xray._pending
+    # a NON-compiled hang (an eager collective, a loader stall) must
+    # not burn the one-shot on a trace that can't explain it
+    xray.reset()
+    entry = {"site": "ps_push", "since": time.time() - 5.0,
+             "detail": {"keys": 3}, "thread": "MainThread"}
+    wd.trip(entry, 5.0)
+    assert xray._pending == []
+
+
+# ---------------------------------------------------------------------------
+# cost ledger + retrace diffing (the EH301 feed)
+# ---------------------------------------------------------------------------
+
+class _FakeCompiled(object):
+    """Weakref-able stand-in for jax.stages.Compiled."""
+
+    def __init__(self, flops, hlo=""):
+        self._flops = float(flops)
+        self._hlo = hlo
+
+    def cost_analysis(self):
+        return {"flops": self._flops, "bytes accessed": 4096.0}
+
+    def memory_analysis(self):
+        return types.SimpleNamespace(temp_size_in_bytes=128,
+                                     argument_size_in_bytes=256,
+                                     output_size_in_bytes=64,
+                                     generated_code_size_in_bytes=32)
+
+    def as_text(self):
+        return self._hlo
+
+
+def test_note_program_journals_costs_and_retrace_diffs(fresh_xray):
+    marker = time.time()
+    c1 = _FakeCompiled(1000.0)
+    c2 = _FakeCompiled(2500.0)
+    costs = xray.note_program("gstep_one", c1, label="one/4p/2b")
+    assert costs["flops"] == 1000.0
+    assert costs["bytes_accessed"] == 4096.0
+    assert costs["temp_bytes"] == 128.0
+    assert xray.cost_regressions() == ""          # first build: no diff
+    xray.note_program("gstep_one", c2, label="one/4p/2b")
+    hist = xray.cost_history("gstep_one")
+    assert [h["flops"] for h in hist] == [1000.0, 2500.0]
+    line = xray.cost_regressions()
+    assert "gstep_one" in line and "flops" in line
+    assert "1e+03" in line and "2.5e+03" in line
+    evs = [e for e in blackbox.events() if e.get("ts", 0) >= marker]
+    kinds = [e["kind"] for e in evs]
+    assert kinds.count("xray_cost") == 2
+    diffs = [e for e in evs if e["kind"] == "xray_cost_diff"]
+    assert len(diffs) == 1
+    assert diffs[0]["data"]["program"] == "gstep_one"
+    assert diffs[0]["data"]["flops"] == {"old": 1000.0, "new": 2500.0}
+    del c1, c2
+
+
+def test_cost_regressions_ignores_shrinkage(fresh_xray):
+    """The storm report names what got MORE expensive; a program that
+    got cheaper is not a regression."""
+    xray.note_program("p", _FakeCompiled(2000.0))
+    xray.note_program("p", _FakeCompiled(500.0))
+    assert xray.cost_regressions() == ""
+
+
+def test_diff_costs_threshold():
+    old = {"flops": 1000.0, "temp_bytes": 64.0}
+    assert xray.diff_costs(old, {"flops": 1001.0, "temp_bytes": 64.0}) \
+        == {}                                # < 0.5%: noise, not a diff
+    d = xray.diff_costs(old, {"flops": 1200.0})
+    assert d["flops"] == (1000.0, 1200.0)
+    assert d["temp_bytes"] == (64.0, None)   # disappeared fields surface
+
+
+def test_scope_maps_resolve_lazily_from_live_executables(fresh_xray):
+    c = _FakeCompiled(1.0, hlo=_HLO)
+    xray.note_program("gstep_one", c)
+    maps = xray._scope_maps()
+    assert maps["gstep_one"]["fusion.1"] == "forward"
+    # a collected executable drops out instead of erroring
+    xray.note_program("gone", _FakeCompiled(1.0))
+    import gc
+    gc.collect()
+    assert "gone" not in xray._scope_maps() or \
+        xray._scope_maps().get("gone") is not None
+    del c
+
+
+def test_eh301_storm_report_names_cost_growth(fresh_xray):
+    """The retrace-storm warning must carry the cost-ledger diff: not
+    just WHICH guard churned but what got more expensive."""
+    from incubator_mxnet_tpu.analysis.compile_safety import StepAuditor
+    xray.note_program("gstep_one", _FakeCompiled(1000.0))
+    xray.note_program("gstep_one", _FakeCompiled(3000.0))
+    aud = StepAuditor(label="t")
+    with warnings.catch_warnings(record=True) as got:
+        warnings.simplefilter("always")
+        for _ in range(StepAuditor.STORM_MISSES):
+            aud.note_call()
+            aud.note_miss("bspecs", "bucket count 2 -> 3")
+    storm = [w for w in got if "EH301" in str(w.message)]
+    assert storm, "no EH301 storm warning raised"
+    msg = str(storm[-1].message)
+    assert "cost growth since previous trace" in msg
+    assert "gstep_one" in msg and "flops" in msg
+
+
+# ---------------------------------------------------------------------------
+# the full compiled window (the selftest is the acceptance contract)
+# ---------------------------------------------------------------------------
+
+def test_xray_selftest_compiled_window_conserves():
+    """End-to-end: a real compiled 3-step capture on this backend —
+    phase rows present, conservation EXACT, armed-idle dispatches
+    inert.  (The same scenario lint tier 12 runs.)"""
+    problems = xray.selftest()
+    assert problems == [], problems
+
+
+def test_capture_session_publishes_to_lens_and_blackbox(monkeypatch):
+    """Run the selftest scenario manually and check the publication
+    fan-out: blackbox xray_capture event, lens window annotation."""
+    from incubator_mxnet_tpu.gluon import Trainer
+    from incubator_mxnet_tpu.gluon import step_compile as sc
+    monkeypatch.setenv("GRAFT_XRAY", "1")
+    monkeypatch.setenv("GRAFT_XRAY_STEPS", "2")
+    monkeypatch.delenv("GRAFT_XRAY_EVERY", raising=False)
+    xray.reset()
+    marker = time.time()
+    try:
+        net = sc._make_net("graftxraytest_", n_params=3, shape=(1, 4))
+        sc._seed_params(net)
+        tr = Trainer(net.collect_params(), "sgd",
+                     {"learning_rate": 0.05}, kvstore=None)
+        cstep = sc.CompiledStep(tr, net, enabled=True)
+        rng = np.random.RandomState(3)
+
+        def batch():
+            return mx.nd.array(
+                rng.uniform(0.5, 1.5, (4, 4)).astype(np.float32))
+
+        for _ in range(2):
+            cstep(batch())
+        assert cstep.compiled_steps >= 1
+        assert xray.request_capture("test-hook")
+        for _ in range(3):
+            cstep(batch())
+        sess = xray.sessions()
+        assert sess and sess[-1]["ok"], sess
+        s = sess[-1]
+        assert s["reason"] == "test-hook"
+        assert s["steps"] == 2
+        rep = s["report"]
+        assert rep["conservation_ok"]
+        assert rep["phases"]
+        evs = [e for e in blackbox.events()
+               if e["kind"] == "xray_capture" and e["ts"] >= marker]
+        assert evs and evs[-1]["data"]["reason"] == "test-hook"
+        assert evs[-1]["data"]["conservation_ok"] is True
+        if lens.enabled():
+            annotated = [r for r in lens.steps() if "xray" in r]
+            assert annotated
+            x = annotated[-1]["xray"]
+            assert x["reason"] == "test-hook"
+            assert x["program_device_s"] > 0.0
+    finally:
+        xray.reset()
+
+
+# ---------------------------------------------------------------------------
+# the --xray renderer
+# ---------------------------------------------------------------------------
+
+def _fake_session(reason="manual", ok=True):
+    return {"reason": reason, "steps": 3, "wall_s": 0.5,
+            "at": time.time(), "ok": ok,
+            "report": {"phases": {"forward": {"device_s": 1.5e-3,
+                                              "share": 0.6},
+                                  "backward": {"device_s": 0.5e-3,
+                                               "share": 0.2}},
+                       "unattributed_s": 0.5e-3,
+                       "program_device_s": 2.5e-3,
+                       "conservation_ok": True,
+                       "top_ops": [{"op": "fusion.1", "phase": "forward",
+                                    "device_s": 1.0e-3, "count": 3}]}}
+
+
+def test_cli_xray_renders_live_sessions(capsys):
+    from incubator_mxnet_tpu.telemetry.__main__ import main as tmain
+    xray.reset()
+    try:
+        with xray._session_lock:
+            xray._sessions.append(_fake_session("slow-step"))
+        assert tmain(["--xray"]) == 0
+        out = capsys.readouterr().out
+        assert "slow-step" in out
+        assert "forward" in out and "backward" in out
+        assert "conservation EXACT" in out
+        assert "fusion.1" in out
+    finally:
+        xray.reset()
+
+
+def test_cli_xray_renders_blackbox_dump(tmp_path, capsys):
+    """Dump events nest fields under "data" — the renderer must read
+    them there (not flat) and fall back to the flattened phase dict the
+    blackbox publication writes."""
+    from incubator_mxnet_tpu.telemetry.__main__ import main as tmain
+    doc = {"events": [
+        {"ts": 1.0, "kind": "xray_capture",
+         "data": {"reason": "watchdog:compiled_step", "steps": 2,
+                  "ok": True, "phases": {"forward": 0.002},
+                  "unattributed_s": 0.001, "program_device_s": 0.003,
+                  "conservation_ok": True,
+                  "top_ops": [{"op": "sub.3", "phase": "backward",
+                               "device_us": 11.5, "count": 2}]}},
+        {"ts": 2.0, "kind": "other", "data": {}},
+    ]}
+    p = tmp_path / "dump.json"
+    p.write_text(json.dumps(doc))
+    assert tmain(["--xray", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "watchdog:compiled_step" in out
+    assert "forward" in out
+    assert "conservation EXACT" in out
+    assert tmain(["--xray", str(p), "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed[0]["reason"] == "watchdog:compiled_step"
+
+
+def test_cli_xray_empty_state_hints_at_arming(capsys):
+    from incubator_mxnet_tpu.telemetry.__main__ import main as tmain
+    xray.reset()
+    assert tmain(["--xray"]) == 0
+    assert "GRAFT_XRAY=1" in capsys.readouterr().out

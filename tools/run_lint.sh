@@ -75,6 +75,16 @@
 #    op registry; bench_eager --smoke (tier 3) additionally reports
 #    compile_check_overhead_pct (auditor armed, zero findings) against
 #    its < 2% budget in BENCH JSON.
+# 12. graftxray smoke — telemetry.xray --selftest captures a triggered
+#    3-dispatch profiler session around the REAL compiled step and
+#    asserts in-program phase attribution (forward/backward/update[k]
+#    scopes resolved from the executable's optimized HLO against the
+#    trace's hlo_op stream) with EXACT-sum conservation (phase device
+#    ns + unattributed == program device span, integer equality), cost
+#    summaries registered at trace time, and armed-but-idle dispatches
+#    opening no session; bench_eager --smoke (tier 3) additionally
+#    gates xray_overhead_pct (harness armed, no capture) against its
+#    < 2% budget in BENCH JSON.
 #
 # Usage: tools/run_lint.sh [report.json]
 set -uo pipefail
@@ -108,5 +118,8 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     || exit $?
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m incubator_mxnet_tpu.analysis.compile_safety --selftest \
+    || exit $?
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m incubator_mxnet_tpu.telemetry.xray --selftest \
     || exit $?
 exec python -m incubator_mxnet_tpu.telemetry --selftest
