@@ -53,6 +53,14 @@ loop (no sync mode — flush-boundary reaper enqueues and mem-timeline
 probes firing) with the async device-time ledger on vs off, each round
 draining the reaper inside its own window.  Same < 2% bar as the lens.
 
+Round 19 (graftzero) adds ``quant_step_*`` / ``zero_step_*``: the same
+64-param dist_sync loop with the block-scaled quantized bucket wire
+(``GRAFT_QUANT_REDUCE=int8`` — wire bytes off the kvstore counters,
+gated >= 3.5x below f32; the ``=0`` escape hatch asserted bit-identical
+at < 2% overhead) and, via an 8-device child process, the ZeRO-1
+sharded update (``GRAFT_SHARD_OPTIMIZER=1`` — byte-parity with the
+unsharded ctx-0 replica, per-shard optimizer-state bytes ~1/N).
+
 Round 17 (graftguard) adds ``compile_check_overhead_pct``: the compiled
 whole-step path (graftstep) timed with the EH3xx runtime auditor armed
 (guard-key bookkeeping, bake-hash recheck, donated-buffer poisoning and
@@ -431,6 +439,222 @@ def _compiled_step_bench(iters=12, repeats=3, n_params=FUSED_N_PARAMS,
         "compiled_step_compiled_total": cstep.compiled_steps,
         "compiled_step_fallback_total": cstep.fallback_steps,
     }
+
+
+def _quant_step_bench(iters=8, repeats=3, n_params=FUSED_N_PARAMS,
+                      shape=FUSED_SHAPE, bucket_bytes=1 << 14):
+    """graftzero quantized wire: the 64-param dist_sync train loop run
+    three ways — baseline (no quant env), explicit off
+    (``GRAFT_QUANT_REDUCE=0``, must stay BIT-identical with < 2%
+    overhead: the escape-hatch contract) and ``int8`` (wire bytes
+    measured off the kvstore counters, gated >= 3.5x below f32; params
+    asserted within the documented block-scale tolerance).  Arms run
+    sequentially, each under its own env value, because the quantizer
+    resolves the mode at every step."""
+    import os
+    import jax.numpy as jnp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, telemetry
+
+    def build(prefix):
+        rs = np.random.RandomState(0)
+        ps = []
+        for k in range(n_params):
+            p = gluon.Parameter("%s%d" % (prefix, k), shape=shape)
+            p.initialize(ctx=mx.cpu())
+            p.data()._write(jnp.asarray(rs.randn(*shape).astype(np.float32)))
+            ps.append(p)
+        t = gluon.Trainer(ps, "sgd", {"learning_rate": 0.01},
+                          kvstore=mx.kv.create("dist_sync"))
+        t._bucket_bytes_override = bucket_bytes
+        return ps, t
+
+    rs = np.random.RandomState(1)
+    consts = [mx.nd.array(rs.randn(*shape).astype(np.float32))
+              for _ in range(n_params)]
+
+    def train_round(params, trainer, n):
+        step_s = 0.0
+        for _ in range(n):
+            with autograd.record():
+                loss = None
+                for p, c in zip(params, consts):
+                    y = (p.data() * p.data() * c).sum()
+                    loss = y if loss is None else loss + y
+            loss.backward()
+            t0 = time.perf_counter()
+            trainer.step(1)
+            step_s += time.perf_counter() - t0
+        params[-1].data().asnumpy()              # sync
+        return step_s / max(n, 1)
+
+    def wire_counter():
+        return float(telemetry.compact_snapshot().get(
+            "graft_kvstore_wire_bytes_total", 0.0))
+
+    arms, times, wire = {}, {}, {}
+    saved = os.environ.get("GRAFT_QUANT_REDUCE")
+    try:
+        for arm, env in (("base", None), ("off", "0"), ("int8", "int8")):
+            os.environ.pop("GRAFT_QUANT_REDUCE", None)
+            if env is not None:
+                os.environ["GRAFT_QUANT_REDUCE"] = env
+            ps, t = build("q" + arm)
+            train_round(ps, t, 2)                # warm: plan + compiles
+            w0 = wire_counter()
+            best = float("inf")
+            for _ in range(repeats):
+                best = min(best, train_round(ps, t, iters))
+            arms[arm] = ps
+            times[arm] = best
+            wire[arm] = wire_counter() - w0
+    finally:
+        os.environ.pop("GRAFT_QUANT_REDUCE", None)
+        if saved is not None:
+            os.environ["GRAFT_QUANT_REDUCE"] = saved
+
+    off_parity = all(
+        a.data().asnumpy().tobytes() == b.data().asnumpy().tobytes()
+        for a, b in zip(arms["base"], arms["off"]))
+    assert off_parity, \
+        "GRAFT_QUANT_REDUCE=0 escape hatch is not bit-identical"
+    maxdiff = max(
+        float(np.abs(a.data().asnumpy() - b.data().asnumpy()).max())
+        for a, b in zip(arms["base"], arms["int8"]))
+    # loose end-to-end ceiling: the per-step per-element bound is
+    # lr * max|block|/254 (observability.md quantization contract);
+    # this workload's gradients keep it orders of magnitude below 1e-2
+    assert maxdiff < 1e-2, \
+        "int8 quantized params drifted %.4g from the float oracle" % maxdiff
+    ratio = wire["base"] / max(wire["int8"], 1.0)
+    return {
+        "quant_step_params": n_params,
+        "quant_step_base_ms": round(times["base"] * 1e3, 3),
+        "quant_step_off_ms": round(times["off"] * 1e3, 3),
+        "quant_step_int8_ms": round(times["int8"] * 1e3, 3),
+        "quant_step_latency_ratio": round(
+            times["int8"] / times["base"], 3),
+        "quant_off_overhead_pct": round(
+            (times["off"] / times["base"] - 1.0) * 100.0, 2),
+        "quant_off_parity": off_parity,
+        "quant_wire_bytes_f32": int(wire["base"]),
+        "quant_wire_bytes_int8": int(wire["int8"]),
+        "quant_wire_ratio": round(ratio, 2),
+        "quant_int8_maxdiff": maxdiff,
+    }
+
+
+def _zero_step_bench(steps=4):
+    """graftzero ZeRO-1: the sharded update needs a multi-device mesh,
+    and the host platform's device count is fixed at jax import — so
+    this bench re-execs itself (``--zero-child``) with an 8-device CPU
+    mesh and parses the child's JSON line.  The child asserts the
+    sharded params byte-identical to the unsharded step's ctx-0 replica
+    and reports the per-shard optimizer-state bytes (the ~1/N claim)
+    straight off ``Updater.states_nbytes`` + the shard-bytes gauge."""
+    import os
+    import subprocess
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("GRAFT_SHARD_OPTIMIZER", None)
+    env.pop("GRAFT_QUANT_REDUCE", None)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--zero-child",
+         str(int(steps))],
+        env=env, capture_output=True, text=True, timeout=600)
+    if out.returncode != 0:
+        raise RuntimeError("zero_step child failed:\n%s"
+                           % (out.stderr or out.stdout)[-4000:])
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _zero_step_child(steps=4, n_params=24, shape=(16, 16),
+                     bucket_bytes=1 << 12):
+    """The in-mesh body of :func:`_zero_step_bench` (run with 8 host
+    devices): unsharded vs ``GRAFT_SHARD_OPTIMIZER=1`` momentum-SGD
+    steps over 8 context replicas, byte-parity + state-shard report."""
+    import os
+    import jax.numpy as jnp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, engine, gluon, telemetry
+
+    n_ctx = 8
+    ctxs = [mx.cpu(i) for i in range(n_ctx)]
+    rs = np.random.RandomState(0)
+    weights = [rs.randn(*shape).astype(np.float32) for _ in range(n_params)]
+    base = [rs.randn(*shape).astype(np.float32) for _ in range(n_params)]
+
+    def build(prefix, zero):
+        os.environ.pop("GRAFT_SHARD_OPTIMIZER", None)
+        if zero:
+            os.environ["GRAFT_SHARD_OPTIMIZER"] = "1"
+        ps = []
+        for k in range(n_params):
+            p = gluon.Parameter("%s%d" % (prefix, k), shape=shape)
+            p.initialize(ctx=ctxs)
+            ps.append(p)
+        for p, w in zip(ps, weights):
+            for d in p.list_data():
+                d._write(engine.colocate(jnp.asarray(w), d._read()))
+        t = gluon.Trainer(ps, "sgd",
+                          {"learning_rate": 0.01, "momentum": 0.9},
+                          kvstore=mx.kv.create("dist_sync"))
+        t._bucket_bytes_override = bucket_bytes
+        consts = [[mx.nd.array(c * (j + 1), ctx=ctx)
+                   for j, ctx in enumerate(ctxs)] for c in base]
+        return ps, t, consts
+
+    def run(ps, t, consts, n, warm=2):
+        step_s = 0.0
+        for it in range(warm + n):
+            with autograd.record():
+                losses = []
+                for j, ctx in enumerate(ctxs):
+                    loss = None
+                    for p, cs in zip(ps, consts):
+                        d = p.data(ctx)
+                        y = (d * d * cs[j]).sum()
+                        loss = y if loss is None else loss + y
+                    losses.append(loss)
+            autograd.backward(losses)
+            t0 = time.perf_counter()
+            t.step(n_ctx)
+            if it >= warm:
+                step_s += time.perf_counter() - t0
+        ps[-1].data(ctxs[0]).asnumpy()           # sync
+        return step_s / max(n, 1)
+
+    pa, ta, ca = build("u", False)
+    dt_u = run(pa, ta, ca, steps)
+    unsharded_bytes = ta._updaters[0].states_nbytes()
+    pb, tb, cb = build("z", True)
+    dt_z = run(pb, tb, cb, steps)
+    os.environ.pop("GRAFT_SHARD_OPTIMIZER", None)
+    parity = all(
+        pa[k].list_data()[0].asnumpy().tobytes()
+        == pb[k].list_data()[0].asnumpy().tobytes()
+        for k in range(n_params))
+    assert parity, \
+        "ZeRO-1 sharded step diverged from the unsharded ctx-0 replica"
+    shard_bytes = max(u.states_nbytes() for u in tb._updaters)
+    gauge = float(telemetry.compact_snapshot().get(
+        "graft_trainer_state_shard_bytes", 0.0))
+    assert gauge == float(shard_bytes), \
+        "shard-bytes gauge %.0f != measured %d" % (gauge, shard_bytes)
+    print(json.dumps({
+        "zero_step_params": n_params,
+        "zero_step_ctxs": n_ctx,
+        "zero_step_unsharded_ms": round(dt_u * 1e3, 3),
+        "zero_step_sharded_ms": round(dt_z * 1e3, 3),
+        "zero_step_latency_ratio": round(dt_z / dt_u, 3),
+        "zero_step_parity": parity,
+        "zero_state_unsharded_bytes": int(unsharded_bytes),
+        "zero_state_shard_bytes": int(shard_bytes),
+        "zero_state_shard_fraction": round(
+            shard_bytes / max(unsharded_bytes, 1), 4),
+    }))
 
 
 def _lens_overhead_bench(iters=20, repeats=4, n_params=8, shape=(16, 16)):
@@ -907,6 +1131,19 @@ def smoke():
     assert res["compiled_step_latency_ratio"] <= 0.8, \
         "compiled step is not fast enough: ratio %.3f > 0.8" \
         % res["compiled_step_latency_ratio"]
+    res.update(_quant_step_bench(iters=5, repeats=2))
+    # graftzero acceptance gates: int8 wire >= 3.5x below f32, the off
+    # escape hatch bit-identical at < 2% overhead
+    assert res["quant_wire_ratio"] >= 3.5, \
+        "int8 wire ratio %.2f < 3.5" % res["quant_wire_ratio"]
+    assert res["quant_off_overhead_pct"] < 2.0, \
+        "quant-off escape hatch overhead %.2f%% >= 2%%" \
+        % res["quant_off_overhead_pct"]
+    res.update(_zero_step_bench(steps=3))
+    assert res["zero_step_parity"], "ZeRO-1 parity failed"
+    assert res["zero_state_shard_fraction"] <= 0.5, \
+        "ZeRO-1 shard fraction %.3f not ~1/N" \
+        % res["zero_state_shard_fraction"]
     res.update(_blackbox_overhead_bench(iters=10, repeats=3))
     res.update(_lens_overhead_bench(iters=10, repeats=3))
     res.update(_pulse_overhead_bench(iters=10, repeats=3))
@@ -1077,6 +1314,10 @@ def main():
     # -- graftstep: whole-step compiled training (round 16) --------------
     compiled = _compiled_step_bench(iters=ITERS // 2)
 
+    # -- graftzero: quantized wire + ZeRO-1 sharded update (round 19) ----
+    quant = _quant_step_bench(iters=ITERS // 4)
+    zero = _zero_step_bench(steps=ITERS // 6)
+
     # -- graftwatch: flight-recorder overhead on the same 64-op chain ----
     blackbox_overhead = _blackbox_overhead_bench()
 
@@ -1094,6 +1335,8 @@ def main():
         **overlap,
         **duplex,
         **compiled,
+        **quant,
+        **zero,
         **blackbox_overhead,
         **lens_overhead,
         **pulse_overhead,
@@ -1133,7 +1376,10 @@ def main():
 
 
 if __name__ == "__main__":
-    if "--smoke" in sys.argv[1:]:
+    if "--zero-child" in sys.argv[1:]:
+        _zero_step_child(steps=int(sys.argv[sys.argv.index("--zero-child")
+                                            + 1]))
+    elif "--smoke" in sys.argv[1:]:
         smoke()
     else:
         main()

@@ -130,10 +130,10 @@ EH_RULES = {
     "EH304": "compiled-vs-eager ULP divergence on a sentinel step",
 }
 
-# the eight components of CompiledStep._guard_key, in tuple order
+# the nine components of CompiledStep._guard_key, in tuple order
 GUARD_COMPONENTS = ("input-sig", "input-fmt", "param-set", "param-meta",
                     "optimizer-sig", "n-ctx", "kvstore-sig",
-                    "bucket-bytes")
+                    "bucket-bytes", "quant-cfg")
 
 
 # ---------------------------------------------------------------------------

@@ -18,11 +18,13 @@ incubator_mxnet_tpu.armor --selftest`` proves the machinery end to end.
 from __future__ import annotations
 
 from .errors import (ArmorError, FaultInjectedError, PSUnavailableError,
-                     CollectiveTimeoutError, CheckpointCorruptError)
+                     CollectiveTimeoutError, CheckpointCorruptError,
+                     ShardOwnershipError)
 from .faults import fault_point, configure, reset, active_rules, set_rank
 
 __all__ = [
     "ArmorError", "FaultInjectedError", "PSUnavailableError",
     "CollectiveTimeoutError", "CheckpointCorruptError",
+    "ShardOwnershipError",
     "fault_point", "configure", "reset", "active_rules", "set_rank",
 ]

@@ -47,7 +47,7 @@ import time
 
 import numpy as np
 
-from .errors import CheckpointCorruptError
+from .errors import CheckpointCorruptError, ShardOwnershipError
 
 __all__ = ["FORMAT", "save_state", "load_state", "manifest_of",
            "snapshot_trainer", "restore_trainer", "Checkpointer",
@@ -193,15 +193,26 @@ def snapshot_trainer(trainer, step, extra=None):
     params = {}
     for p in trainer._params:
         params[p.name] = np.asarray(p.list_data()[0]._read())
-    return {
+    shard = getattr(trainer, "_zero_spec", None)
+    shard = shard() if callable(shard) else None
+    state = {
         "format": FORMAT,
         "step": int(step),
         "params": params,
-        "optimizer": _updater_states(trainer),
+        "optimizer": None if shard else _updater_states(trainer),
         "rng": _random_state.get_state(),
         "saved_at": time.time(),
         "extra": dict(extra or {}),
     }
+    if shard is not None:
+        # ZeRO-1: optimizer state is partitioned by bucket ownership —
+        # capture every local updater's shard (plus its error-feedback
+        # residuals, which live in the same store) and the layout spec
+        # so restore can refuse a mismatched topology.
+        state["shard"] = dict(shard)
+        state["optimizer_shards"] = [u.get_states(dump_optimizer=True)
+                                     for u in trainer._updaters]
+    return state
 
 
 def restore_trainer(trainer, state):
@@ -212,18 +223,38 @@ def restore_trainer(trainer, state):
     ``_init_kvstore`` broadcast/init path."""
     import jax.numpy as jnp
     from .. import random_state as _random_state
+    saved_shard = state.get("shard")
+    cur = getattr(trainer, "_zero_spec", None)
+    cur_shard = cur() if callable(cur) else None
+    if (saved_shard or None) != (dict(cur_shard) if cur_shard else None):
+        # refuse BEFORE touching anything: a sharded snapshot on an
+        # unsharded trainer (or vice versa, or a different rank/shard
+        # count) would restore at most one shard's optimizer state
+        raise ShardOwnershipError(saved_shard, cur_shard)
     params = state.get("params", {})
     by_name = {p.name: p for p in trainer._params}
     missing = sorted(set(by_name) - set(params))
     if missing:
         raise CheckpointCorruptError(
             "<state>", "snapshot lacks params: %s" % missing[:5])
+    from .. import engine as _engine
     for name, val in params.items():
         p = by_name.get(name)
         if p is None:
             continue            # extra param in snapshot: ignore
         for d in p.list_data():
-            d._write(jnp.asarray(val).astype(d.dtype))
+            # colocate: each replica keeps its committed device — a bare
+            # device_put would un-commit and break multi-ctx fused jits
+            d._write(_engine.colocate(jnp.asarray(val).astype(d.dtype),
+                                      d._read()))
+    if saved_shard is not None:
+        shards = state.get("optimizer_shards") or []
+        if len(shards) != len(trainer._updaters):
+            raise CheckpointCorruptError(
+                "<state>", "snapshot has %d optimizer shards, trainer "
+                "has %d updaters" % (len(shards), len(trainer._updaters)))
+        for updater, blob in zip(trainer._updaters, shards):
+            updater.set_states(blob)
     opt_bytes = state.get("optimizer")
     if opt_bytes is not None:
         if getattr(trainer, "_kv_initialized", False) \

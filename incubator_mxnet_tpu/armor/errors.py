@@ -10,7 +10,8 @@ circular-import risk.
 """
 
 __all__ = ["ArmorError", "FaultInjectedError", "PSUnavailableError",
-           "CollectiveTimeoutError", "CheckpointCorruptError"]
+           "CollectiveTimeoutError", "CheckpointCorruptError",
+           "ShardOwnershipError"]
 
 
 class ArmorError(RuntimeError):
@@ -78,3 +79,25 @@ class CheckpointCorruptError(ArmorError):
         super().__init__("checkpoint %s is not loadable: %s" % (path, reason))
         self.path = str(path)
         self.reason = reason
+
+
+class ShardOwnershipError(ArmorError):
+    """A snapshot's ZeRO-1 shard layout does not match the resuming
+    trainer's: a sharded snapshot landing on an unsharded trainer, an
+    unsharded snapshot landing on a sharded one, or two sharded runs
+    with different shard counts/axes.  Optimizer state is partitioned
+    by bucket ownership, so silently restoring across layouts would
+    leave most shards untrained; the saved and current specs travel in
+    ``.saved`` / ``.current`` for supervisors to reconcile."""
+
+    def __init__(self, saved, current):
+        def _fmt(spec):
+            if not spec:
+                return "unsharded"
+            return "%s-sharded n=%s" % (spec.get("axis"), spec.get("n"))
+        super().__init__(
+            "shard layout mismatch: snapshot is %s but this trainer is "
+            "%s — re-launch with the snapshot's GRAFT_SHARD_OPTIMIZER "
+            "topology (or retrain)" % (_fmt(saved), _fmt(current)))
+        self.saved = dict(saved) if saved else None
+        self.current = dict(current) if current else None

@@ -85,6 +85,7 @@ import time
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from .. import autograd
 from .. import engine as _engine
@@ -292,6 +293,21 @@ class CompiledStep(object):
         return out
 
     # -- guards -------------------------------------------------------------
+    def _quant_cfg(self):
+        """graftzero wire config for the compiled boundary: (mode, block)
+        when the quantized bucket wire is on, else None.  Part of the
+        guard key, so toggling ``GRAFT_QUANT_REDUCE`` re-traces exactly
+        once — the encode/decode live INSIDE the donated programs."""
+        tr = self._trainer
+        kv = tr._kvstore_obj
+        if kv is None:
+            return None
+        from ..parallel import quant as _quant
+        mode = _quant.resolve_mode(getattr(kv, "_quant_override", None))
+        if mode is None:
+            return None
+        return (mode, _quant.resolve_block())
+
     def _guard_key(self, args):
         tr = self._trainer
         o = tr._optimizer
@@ -314,6 +330,7 @@ class CompiledStep(object):
             None if kv is None else (type(kv).__name__,
                                      bool(tr._update_on_kvstore)),
             tr._bucket_target_bytes(),
+            self._quant_cfg(),
         )
 
     def _plan_sig(self):
@@ -424,16 +441,71 @@ class CompiledStep(object):
         else:
             update = self._make_update_program(entry)
             update.__name__ = "gstep_update"
-
-            def gstep_fwd_bwd(tv, fv, iv, rng):
-                return fwd_bwd(tv, fv, iv, rng, True)
-
             entry["one"] = None
-            entry["fwd_bwd"] = jax.jit(gstep_fwd_bwd)
-            entry["update"] = jax.jit(update, donate_argnums=donate)
             entry["one_raw"] = None
-            entry["fwd_bwd_raw"] = gstep_fwd_bwd
-            entry["update_raw"] = update
+            qcfg = self._quant_cfg()
+            entry["quant"] = qcfg
+            if qcfg is None:
+                def gstep_fwd_bwd(tv, fv, iv, rng):
+                    return fwd_bwd(tv, fv, iv, rng, True)
+
+                entry["fwd_bwd"] = jax.jit(gstep_fwd_bwd)
+                entry["update"] = jax.jit(update, donate_argnums=donate)
+                entry["fwd_bwd_raw"] = gstep_fwd_bwd
+                entry["update_raw"] = update
+            else:
+                # graftzero: the quantize (error-feedback encode) and
+                # dequantize live INSIDE the donated programs — the host
+                # boundary ships only packed codes + per-block scales
+                # (kv.reduce_quantized).  Residuals ride as operands and
+                # outputs of program A, stored back in the Updater store
+                # under the same keys the eager BucketQuantizer uses, so
+                # eager and compiled steps share one EF trajectory.
+                from ..parallel import quant as _quant
+                mode, qblock = qcfg
+                sizes = tuple(
+                    int(sum(int(np.prod(s)) if s else 1
+                            for s in spec["shapes"]))
+                    for spec in bspecs)
+                qdtypes = tuple(
+                    np.dtype(tr._params[spec["indices"][0]].dtype)
+                    for spec in bspecs)
+                entry["qsizes"] = sizes
+                entry["qdtypes"] = qdtypes
+
+                def gstep_fwd_bwd_q(tv, fv, iv, rng, res):
+                    outs, aux, flats = fwd_bwd(tv, fv, iv, rng, True)
+                    codes, scales, new_res = [], [], []
+                    for k, f in enumerate(flats):
+                        with jax.named_scope("xray:quant[%d]" % k):
+                            acc = f.astype(jnp.float32) + res[k]
+                            c, s = _quant.encode(acc, mode, qblock)
+                            codes.append(c)
+                            scales.append(s)
+                            new_res.append(acc - _quant.decode(
+                                c, s, sizes[k], mode, qblock))
+                    return (outs, aux, tuple(codes), tuple(scales),
+                            tuple(new_res))
+
+                def gstep_update_q(train_vals, state_vals, payloads,
+                                   lrs, wds, rescale):
+                    flats = []
+                    for k in range(len(sizes)):
+                        with jax.named_scope("xray:dequant[%d]" % k):
+                            c, s = payloads[k]
+                            flats.append(_quant.decode(
+                                c, s, sizes[k], mode,
+                                qblock).astype(qdtypes[k]))
+                    return update(train_vals, state_vals, tuple(flats),
+                                  lrs, wds, rescale)
+
+                gstep_fwd_bwd_q.__name__ = "gstep_fwd_bwd_q"
+                gstep_update_q.__name__ = "gstep_update_q"
+                entry["fwd_bwd"] = jax.jit(gstep_fwd_bwd_q)
+                entry["update"] = jax.jit(gstep_update_q,
+                                          donate_argnums=donate)
+                entry["fwd_bwd_raw"] = gstep_fwd_bwd_q
+                entry["update_raw"] = gstep_update_q
 
         # dry abstract trace NOW (jax.eval_shape: no compile, no FLOPs):
         # trace errors surface here as a clean ineligible entry instead
@@ -647,6 +719,28 @@ class CompiledStep(object):
         return (train_vals, frozen_vals, input_vals, frozen_nds,
                 state_nds, tuple(state_vals), train_nds)
 
+    def _gather_residuals(self, entry):
+        """graftzero EF operands: one f32 residual per bucket, read from
+        (and later written back to) the Updater store under the SAME
+        keys the eager BucketQuantizer uses — eager and compiled steps
+        share one error-feedback trajectory, and checkpoint/resume
+        carries it."""
+        from ..parallel import quant as _quant
+        updater = self._trainer._updaters[0]
+        keys, vals = [], []
+        for k, spec in enumerate(entry["bspecs"]):
+            key = _quant.residual_key(spec["indices"],
+                                      entry["qdtypes"][k])
+            r = updater.states.get(key)
+            if r is None:
+                r = jnp.zeros((entry["qsizes"][k],), jnp.float32)
+            elif not isinstance(r, jnp.ndarray):
+                # set_states round trip parks residuals as host numpy
+                r = jnp.asarray(np.asarray(r), dtype=jnp.float32)
+            keys.append(key)
+            vals.append(r)
+        return keys, tuple(vals)
+
     def _aot(self, entry, kind, cargs):
         """Resolve the executable for program ``kind`` ("one",
         "fwd_bwd", "update").  The first dispatch AOT-lowers and
@@ -763,30 +857,64 @@ class CompiledStep(object):
                             self._write_back(entry, new_w, new_s,
                                              state_nds, frozen_nds, aux)
                     else:
+                        qcfg = entry.get("quant")
                         with _ttracing.phase_span("fwd"):
-                            cargs = (train_vals, frozen_vals,
-                                     input_vals, rng)
+                            if qcfg is None:
+                                cargs = (train_vals, frozen_vals,
+                                         input_vals, rng)
+                            else:
+                                res_keys, res_vals = \
+                                    self._gather_residuals(entry)
+                                cargs = (train_vals, frozen_vals,
+                                         input_vals, rng, res_vals)
                             fb_c = self._aot(entry, "fwd_bwd", cargs)
                             t0 = time.perf_counter()
-                            outs, aux, flats = fb_c(*cargs)
-                            _lens.device_async([flats[-1]], t0)
+                            fb_out = fb_c(*cargs)
+                            if qcfg is None:
+                                outs, aux, flats = fb_out
+                                _lens.device_async([flats[-1]], t0)
+                            else:
+                                (outs, aux, qcodes, qscales,
+                                 new_res) = fb_out
+                                _lens.device_async([qscales[-1]], t0)
+                                # EF residual write-back NOW — it is
+                                # this step's local quantization error,
+                                # independent of the wire reduce; same
+                                # store keys as the eager quantizer
+                                updater = tr._updaters[0]
+                                for rk, r in zip(res_keys, new_res):
+                                    updater.states[rk] = r
                         with _ttracing.phase_span("kvstore"):
                             # cross-worker reduce AT the program
                             # boundary: the existing wire, same bytes,
-                            # same algebra
-                            flat_nds = [NDArray(f, ctx=ctx)
-                                        for f in flats]
-                            kv.reduce_many(flat_nds,
-                                           label="compiled_step")
-                            reduced = tuple(f._read() for f in flat_nds)
+                            # same algebra — or (graftzero) the packed
+                            # quantized payload, ONE collective batch
+                            if qcfg is None:
+                                flat_nds = [NDArray(f, ctx=ctx)
+                                            for f in flats]
+                                kv.reduce_many(flat_nds,
+                                               label="compiled_step")
+                                reduced = tuple(f._read()
+                                                for f in flat_nds)
+                            else:
+                                mode, qblock = qcfg
+                                pairs = [(NDArray(c, ctx=ctx),
+                                          NDArray(s, ctx=ctx))
+                                         for c, s in zip(qcodes,
+                                                         qscales)]
+                                kv.reduce_quantized(
+                                    pairs, list(entry["qsizes"]),
+                                    mode, qblock,
+                                    label="compiled_step")
+                                reduced = tuple(
+                                    (c._read(), s._read())
+                                    for c, s in pairs)
                         with _ttracing.phase_span("update"):
                             ref_u = None
                             if sentinel:
                                 aud.check_parity(
-                                    "fwd_bwd", (outs, aux, flats),
-                                    entry["fwd_bwd_raw"](
-                                        train_vals, frozen_vals,
-                                        input_vals, rng))
+                                    "fwd_bwd", fb_out,
+                                    entry["fwd_bwd_raw"](*cargs))
                                 ref_u = entry["update_raw"](
                                     train_vals, state_vals, reduced,
                                     lrs, wds, rescale)

@@ -17,8 +17,12 @@ applied through ONE jitted multi-tensor optimizer program per
 The whole step stays on device (no ``_read()`` round trips between reduce
 and update) and is bit-identical to the per-param path (the fused program
 runs the same registered op formulas element-for-element).  Per-param
-fallbacks: ``ignore_stale_grad``, gradient compression, sparse grads, and
-optimizers without a fused kernel (anything but exact SGD/Adam).  One
+fallbacks: ``ignore_stale_grad``, sparse grads, and optimizers without a
+fused kernel (anything but exact SGD/Adam).  Gradient compression no
+longer forces the serial per-key path: ``set_gradient_compression`` and
+``GRAFT_QUANT_REDUCE=int8|2bit`` route the BUCKET wire through graftzero's
+block-scaled quantization (``parallel.quant``) with error-feedback
+residuals kept in the Updater store.  One
 behavioral delta on the fused path: reduced gradients are consumed
 directly by the update and are NOT written back into
 ``param.list_grad()`` (``allreduce_grads()`` — the grad-accumulation API
@@ -237,7 +241,8 @@ class Trainer(object):
                 elif duplex:
                     self._duplex_store_update(plan, reduced, pull_stale)
                 else:
-                    self._bucketed_update(plan, reduced)
+                    self._bucketed_update(plan, reduced,
+                                          pull_stale=pull_stale)
         # graftlap: (re-)arm the grad-ready hooks so the NEXT backward
         # issues each bucket's reduce the moment its grads finalize;
         # first step after any config change runs serial (the plan must
@@ -428,6 +433,79 @@ class Trainer(object):
         return "bucket[%s:%dp:%dB]" % (np.dtype(b.dtype).name,
                                        len(b.indices), b.nbytes)
 
+    # -- graftzero: quantized bucket wire + ZeRO-1 sharded update -----------
+    def _quant_store(self):
+        """The Updater whose ``states`` dict owns the error-feedback
+        residuals: the store-side updater when the store runs the update
+        (duplex), ``_updaters[0]`` otherwise — either way the store that
+        ``save_states``/armor snapshots already serialize."""
+        kv = self._kvstore_obj
+        if self._update_on_kvstore and kv is not None \
+                and kv._updater is not None:
+            return kv._updater
+        return self._updaters[0]
+
+    def _quantizer(self):
+        """The active :class:`~..parallel.quant.BucketQuantizer`, or
+        None (quantization off — the bit-identical default path).  The
+        env resolution is one dict lookup per call; the quantizer object
+        is cached per (mode, block) so toggling re-resolves cleanly."""
+        kv = self._kvstore_obj
+        if kv is None:
+            return None
+        from ..parallel import quant as _quant
+        mode = _quant.resolve_mode(getattr(kv, "_quant_override", None))
+        if mode is None:
+            return None
+        block = _quant.resolve_block()
+        cached = getattr(self, "_quant_cache", None)
+        if cached is not None and cached[0] == (mode, block):
+            return cached[1]
+        q = _quant.BucketQuantizer(mode, block, self._quant_store)
+        self._quant_cache = ((mode, block), q)
+        return q
+
+    @staticmethod
+    def _quant_eligible(b):
+        # integer buckets ride the dense wire (their sums are exact)
+        return np.issubdtype(np.dtype(b.dtype), np.floating)
+
+    def _sched_reduce_async(self, kv, b, flat):
+        """The overlap scheduler's reduce-issue hook: quantize the
+        bucket payload onto the wire when the quantized path is on,
+        plain ``reduce_many_async`` otherwise — the scheduler itself
+        issues quantized buckets unchanged."""
+        q = self._quantizer()
+        if q is not None and self._quant_eligible(b):
+            return q.reduce_async(kv, b, flat,
+                                  label=self._sched_label(b))
+        return kv.reduce_many_async([flat], label=self._sched_label(b))
+
+    def _zero_spec(self):
+        """The ZeRO-1 shard layout this trainer updates under, or None:
+        ``GRAFT_SHARD_OPTIMIZER=1`` on the local fused path shards the
+        bucket list across contexts (the 8-dev mesh harness) or — with a
+        single context on a real dist wire — across worker ranks."""
+        from ..parallel import quant as _quant
+        if not _quant.zero_enabled():
+            return None
+        kv = self._kvstore_obj if self._kv_initialized else None
+        if kv is None or self._update_on_kvstore:
+            return None
+        n_ctx = len(self._contexts)
+        if n_ctx > 1:
+            return {"axis": "ctx", "n": n_ctx, "rank": 0}
+        if kv.num_workers > 1:
+            return {"axis": "worker", "n": int(kv.num_workers),
+                    "rank": int(kv.rank)}
+        return None
+
+    def _state_shard_nbytes(self):
+        """Max optimizer-state bytes held for one shard owner — what the
+        ``graft_trainer_state_shard_bytes`` gauge reports (metadata
+        walk, never forces a flush)."""
+        return max(u.states_nbytes() for u in self._updaters)
+
     def _plan_order(self):
         """Parameter iteration order for bucket packing:
         ``(mode, sig_perm, build_perm)``.
@@ -505,15 +583,15 @@ class Trainer(object):
         Unlike ``_fused_plan`` the optimizer needs no fused kernel — the
         update runs store-side via ``KVStore.apply_reduced`` with the
         exact per-key updater — so buckets group by dtype alone.
-        Fallbacks: no store, compression (the per-key push quantizes at
-        key granularity — a flat reduce would change the algebra), the
-        dist_async parameter service (pushes must ride the PS RPC; its
-        PULLS still overlap via ``_pull_weights``), sparse params, and
-        unknown shapes."""
+        Fallbacks: no store, the dist_async parameter service (pushes
+        must ride the PS RPC; its PULLS still overlap via
+        ``_pull_weights``), sparse params, and unknown shapes.
+        Compression no longer falls back: the bucket wire quantizes
+        through graftzero (block-scaled, error feedback) instead of the
+        per-key threshold path it used to force."""
         target = self._bucket_target_bytes()
         kv = self._kvstore_obj
         if target <= 0 or kv is None or not self._update_on_kvstore \
-                or kv._compressor is not None \
                 or getattr(kv, "_ps", None) is not None:
             return None
         order_mode, sig_perm, perm = self._plan_order()
@@ -563,8 +641,7 @@ class Trainer(object):
         target = self._bucket_target_bytes()
         kv = self._kvstore_obj
         if target <= 0 or self._update_on_kvstore \
-                or (kv is not None and (kv._compressor is not None
-                                        or kv._updater is not None)):
+                or (kv is not None and kv._updater is not None):
             return None
         optimizer = self._optimizer
         # per-param state arity rides in the signature AND the bucket
@@ -666,8 +743,17 @@ class Trainer(object):
         issued = self._scheduler.take(plan) if overlap else {}
         serial = [b for b in buckets if id(b) not in issued]
         flats = {id(b): self._bucket_flat(b) for b in serial}
-        if serial:
-            kv.reduce_many([flats[id(b)] for b in serial])
+        q = self._quantizer()
+        qb = [b for b in serial
+              if q is not None and self._quant_eligible(b)]
+        dense = [b for b in serial if id(b) not in {id(x) for x in qb}]
+        if qb:
+            # graftzero: float buckets ride the block-scaled quantized
+            # wire — ONE batched quantized collective, EF residuals in
+            # the Updater store, dequantized in place at the boundary
+            q.reduce_serial(kv, qb, flats)
+        if dense:
+            kv.reduce_many([flats[id(b)] for b in dense])
         reduced, exposed_s, inflight_s = {}, 0.0, 0.0
         for b in buckets:
             entry = issued.get(id(b))
@@ -743,10 +829,18 @@ class Trainer(object):
             kv.pull_many(leftover, [self._params[i].list_data()
                                     for i in leftover])
 
-    def _bucketed_update(self, plan, reduced):
+    def _bucketed_update(self, plan, reduced, pull_stale=0):
         """One fused multi-tensor optimizer dispatch per (bucket,
-        context); leftover params take the per-param updater."""
+        context); leftover params take the per-param updater.  With
+        ``GRAFT_SHARD_OPTIMIZER=1`` (graftzero ZeRO-1) the bucket list
+        is sharded: each rank/context runs the fused update — and holds
+        optimizer state — only for its contiguous shard, then broadcasts
+        the updated weights (byte-identical to the unsharded step)."""
         from ..telemetry import lens as _lens
+        shard = self._zero_spec()
+        if shard is not None and plan[0]:
+            return self._bucketed_update_sharded(plan, reduced, shard,
+                                                 pull_stale)
         buckets, leftover = plan
         optimizer = self._optimizer
         n_ctx = len(self._contexts)
@@ -789,11 +883,139 @@ class Trainer(object):
                                       param.list_grad()):
                 upd(i, grad, arr)
 
+    def _bucketed_update_sharded(self, plan, reduced, shard, pull_stale=0):
+        """graftzero ZeRO-1: contiguous shard ownership over the bucket
+        list (``parallel.quant.shard_owners``).  The lr/wd bookkeeping
+        ticks in EXACTLY the unsharded (param outer, context inner)
+        sequence on every rank — update counts, schedulers and Adam's
+        bias correction stay identical — but only the OWNER runs the
+        fused update for a bucket, so only the owner ever creates (and
+        holds) its optimizer state: per-rank state bytes ~1/N, read off
+        the ``graft_trainer_state_shard_bytes`` gauge.  The updated
+        weights then broadcast, byte-identical to the unsharded step:
+
+        * axis="ctx" (the device-mesh harness): the owning context
+          updates; its weights go through the store's assignment branch
+          (``apply_reduced`` — no updater tick) and straight back onto
+          the overlapped ``pull_many_async`` wire bucket-by-bucket — a
+          reduce-scatter + all-gather over the bucket flats.
+        * axis="worker" (dist wire, single ctx): non-owners contribute
+          a zeros flat to ONE dense ``reduce_many`` over the updated
+          weight flats — an all-gather-by-sum that is exact (0 + x is
+          bitwise x, modulo the irrelevant -0.0 + 0.0 corner) and keeps
+          every rank's collective sequence lockstep-symmetric.
+
+        Leftover (non-bucketable) params stay unsharded on every rank.
+        """
+        from ..ndarray import NDArray
+        from ..parallel import quant as _quant
+        from ..telemetry import lens as _lens
+        from ..telemetry import metrics as _tmetrics
+        buckets, leftover = plan
+        kv = self._kvstore_obj
+        optimizer = self._optimizer
+        n_ctx = len(self._contexts)
+        owners = _quant.shard_owners(len(buckets), shard["n"])
+        by_ctx = shard["axis"] == "ctx"
+        rank = shard["rank"]
+        if by_ctx:
+            _overlap.publish_pull_round(self._pull_scheduler)
+            all_keys = [i for b in buckets for i in b.indices]
+            overlap = self._pull_overlap_ok(all_keys, pull_stale)
+        for k, b in enumerate(buckets):
+            owner = owners[k]
+            lrs = [0.0] * len(b.indices)
+            wds = [0.0] * len(b.indices)
+            # every (param, context) tick runs so the shared update
+            # count advances exactly as in the unsharded loop; the
+            # update itself always uses the CONTEXT-0 tick column — the
+            # parity target is the unsharded step's context-0 replica
+            # (the only well-defined one: Adam's shared per-index count
+            # gives each unsharded context its own bias correction)
+            for pos, i in enumerate(b.indices):
+                for j in range(n_ctx):
+                    lr, wd = opt.fused_lr_wd(optimizer, i, b.kind)
+                    if j == 0:
+                        lrs[pos] = lr
+                        wds[pos] = wd
+            if by_ctx or owner == rank:
+                j = owner if by_ctx else 0
+                weights = [self._params[i].list_data()[j]
+                           for i in b.indices]
+                grads = None if reduced.get(id(b)) is not None else \
+                    [self._params[i].list_grad()[j] for i in b.indices]
+                fg = reduced.get(id(b))
+                if fg is not None and j > 0:
+                    fg = NDArray(_engine.colocate(fg._read(),
+                                                  weights[0]._read()),
+                                 ctx=self._contexts[j])
+                opt.fused_bucket_update(optimizer, self._updaters[j],
+                                        b.indices, weights, grads,
+                                        lrs, wds, flat_grad=fg)
+            _lens.mem_sample(self._sched_label(b))
+            if by_ctx:
+                kv.apply_reduced(
+                    list(b.indices),
+                    [self._params[i].list_data()[owner]
+                     for i in b.indices])
+                if overlap:
+                    # THIS shard's weights go back on the wire before
+                    # the next bucket updates (the duplex stream shape)
+                    self._pull_scheduler.issue(
+                        kv, list(b.indices),
+                        [self._params[i].list_data() for i in b.indices],
+                        label="zero_pull[%s:%dp:%dB]" % (
+                            np.dtype(b.dtype).name, len(b.indices),
+                            b.nbytes))
+        if by_ctx and not overlap and all_keys:
+            _overlap.serial_pull(
+                kv, all_keys,
+                [self._params[i].list_data() for i in all_keys])
+        if not by_ctx and buckets:
+            import jax.numpy as jnp
+            wflats = []
+            for k, b in enumerate(buckets):
+                if owners[k] == rank:
+                    vals = [self._params[i].list_data()[0]._read()
+                            for i in b.indices]
+                    wflats.append(NDArray(_engine.flatten_arrays(vals),
+                                          ctx=self._contexts[0]))
+                else:
+                    ref = reduced[id(b)]
+                    wflats.append(NDArray(jnp.zeros_like(ref._read()),
+                                          ctx=self._contexts[0]))
+            kv.reduce_many(wflats, label="zero_allgather")
+            for k, b in enumerate(buckets):
+                if owners[k] == rank:
+                    continue    # owner keeps its own (identical) bytes
+                shapes = [self._params[i].shape for i in b.indices]
+                pieces = _engine.split_flat(wflats[k]._read(), shapes)
+                for i, piece in zip(b.indices, pieces):
+                    tgt = self._params[i].list_data()[0]
+                    tgt._write(_engine.colocate(piece, tgt._read()))
+        for i in leftover:
+            param = self._params[i]
+            for upd, arr, grad in zip(self._updaters, param.list_data(),
+                                      param.list_grad()):
+                upd(i, grad, arr)
+        # per-rank optimizer-state footprint gauge: the acceptance gate
+        # for "state bytes ~1/N" reads this
+        _tmetrics.trainer_state_shard_bytes(self._state_shard_nbytes(),
+                                            shard["n"])
+        _lens.mem_sample("zero_shard")
+
     def save_states(self, fname):
         """ref: trainer.py:202 save_states."""
         assert self._optimizer is not None
         if not self._kv_initialized:
             self._init_kvstore()
+        if self._zero_spec() is not None:
+            raise ValueError(
+                "save_states cannot serialize a ZeRO-1 sharded trainer "
+                "(GRAFT_SHARD_OPTIMIZER=1): each rank/context holds only "
+                "its shard of the optimizer state.  Use "
+                "trainer.checkpointer(...) — armor snapshots carry the "
+                "shard layout and every shard's states.")
         if self._update_on_kvstore:
             if self._kvstore_obj._updater is None:
                 # dist_async: optimizer state lives on the parameter
@@ -811,6 +1033,11 @@ class Trainer(object):
         """ref: trainer.py:218 load_states."""
         if not self._kv_initialized:
             self._init_kvstore()
+        if self._zero_spec() is not None:
+            raise ValueError(
+                "load_states cannot restore into a ZeRO-1 sharded trainer "
+                "(GRAFT_SHARD_OPTIMIZER=1): a flat states blob has no "
+                "shard layout.  Use trainer.checkpointer(...).resume().")
         with open(fname, "rb") as f:
             states = f.read()
         if self._update_on_kvstore:

@@ -262,6 +262,9 @@ class KVStore(object):
         self._store = {}           # key -> NDArray (the "server" copy)
         self._updater = None
         self._compressor = None
+        self._quant_override = None  # set_gradient_compression("2bit")
+        #                              routes the BUCKET wire onto the
+        #                              block-scaled quant path (graftzero)
         self._str_keys = None
 
     # -- identity ----------------------------------------------------------
@@ -325,7 +328,8 @@ class KVStore(object):
         with _blackbox.collective("push", n_keys=len(entries),
                                   keys=[k for k, _ in entries[:4]],
                                   nbytes=raw_bytes, wire_bytes=wire_bytes):
-            self._cross_worker_reduce_many([r for _, r in entries])
+            self._cross_worker_reduce_many([r for _, r in entries],
+                                           compress=True)
         for k, red in entries:
             if self._updater is not None:
                 self._updater(_int_key(k), red, self._store[k])
@@ -334,14 +338,19 @@ class KVStore(object):
                 # kvstore_local.h PushImpl assigns local = merged)
                 self._store[k]._write(red._read().astype(self._store[k].dtype))
 
-    def _cross_worker_reduce_many(self, reds, heartbeat=True):
+    def _cross_worker_reduce_many(self, reds, heartbeat=True,
+                                  compress=False):
         """Single-process store: nothing to do (dist overrides with one
         fused collective over all values; mutates them in place).
         ``heartbeat=False`` marks async issues: the dist path skips its
         piggybacked worker-heartbeat allreduce there, because reading the
         heartbeat result host-side would serialize against the bucket
         collective just dispatched — exactly the wait graftlap exists to
-        avoid."""
+        avoid.  ``compress=True`` marks per-key PUSH traffic — the only
+        wire the legacy 2-bit compressor may touch; bucket flats
+        (``reduce_many*``) quantize through the block-scaled graftzero
+        path instead and must never hit the per-key compressor's
+        thresholding."""
         return reds
 
     def push_many(self, keys, values, priority=0):
@@ -416,6 +425,82 @@ class KVStore(object):
             bracket.__exit__(*sys.exc_info())
             raise
         return ReduceHandle(values, label=label, _bracket=bracket)
+
+    # -- graftzero: the block-scaled quantized bucket wire ------------------
+    @staticmethod
+    def _quant_signature(n_elems, mode, block):
+        """The wire signature the lockstep auditor folds: mode, block
+        size, total block count and quantized byte count.  A rank that
+        disagrees on ``GRAFT_QUANT_REDUCE``/``GRAFT_QUANT_BLOCK`` folds
+        a different digest and is NAMED by the heartbeat cross-check
+        before the mispaired collective hangs the wire."""
+        from .parallel import quant as _quant
+        nb = sum(_quant.n_blocks(n, block) for n in n_elems)
+        wire = sum(_quant.wire_nbytes(n, mode, block) for n in n_elems)
+        return wire, "q:%s:b%d:nb%d" % (mode, int(block), nb)
+
+    def reduce_quantized(self, payloads, n_elems, mode, block, label=None):
+        """Reduce a batch of quantized bucket payloads across workers IN
+        PLACE — the graftzero twin of :meth:`reduce_many`.  ``payloads``
+        is ``[(codes, scales)]`` NDArray pairs (one per bucket, from
+        ``parallel.quant.encode``), ``n_elems`` the per-bucket element
+        counts.  Byte accounting: raw = the f32 bytes the wire replaces,
+        wire = packed codes + scales (the compression-ratio gauge reads
+        the bandwidth saving straight off these).  The whole batch is
+        one flight-recorder bracket whose identity folds the quant
+        signature (lockstep contract)."""
+        if not payloads:
+            return payloads
+        raw = 4 * sum(int(n) for n in n_elems)
+        wire, sig = self._quant_signature(n_elems, mode, block)
+        _tmetrics.kvstore_push(raw, wire)
+        _tmetrics.kvstore_pull(wire)
+        extra = {"label": label} if label else {}
+        with _blackbox.collective("reduce_quant", n_keys=len(payloads),
+                                  nbytes=wire, keys=[sig], **extra):
+            with _xray_boundary(label):
+                self._cross_worker_reduce_quantized(
+                    list(payloads), list(n_elems), mode, block)
+        return payloads
+
+    def reduce_quantized_async(self, payloads, n_elems, mode, block,
+                               label=None):
+        """Issue the quantized payload reduce WITHOUT waiting — the
+        graftzero twin of :meth:`reduce_many_async`, same bracket /
+        watchdog / fault-point contract, quantized byte accounting."""
+        payloads = list(payloads)
+        flat_vals = [a for pair in payloads for a in pair]
+        if not payloads:
+            return ReduceHandle(flat_vals, label=label)
+        raw = 4 * sum(int(n) for n in n_elems)
+        wire, sig = self._quant_signature(n_elems, mode, block)
+        _tmetrics.kvstore_push(raw, wire)
+        _tmetrics.kvstore_pull(wire)
+        bracket = _blackbox.collective(
+            "reduce_quant_async", n_keys=len(payloads), nbytes=wire,
+            keys=[sig], bucket=label)
+        bracket.__enter__()
+        entry = getattr(bracket, "entry", None)
+        if entry is not None:
+            entry["async_pending"] = True
+        try:
+            _faults.fault_point("collective.issue", label=label,
+                                n_values=len(payloads))
+            self._cross_worker_reduce_quantized(
+                payloads, list(n_elems), mode, block, heartbeat=False)
+        except BaseException:
+            bracket.__exit__(*sys.exc_info())
+            raise
+        return ReduceHandle(flat_vals, label=label, _bracket=bracket)
+
+    def _cross_worker_reduce_quantized(self, payloads, n_elems, mode,
+                                       block, heartbeat=True):
+        """Single-process store: the payload already IS the sum (one
+        worker) — nothing moves.  The dist store overrides with the
+        EQuARX-style quantized reduce-scatter + all-gather
+        (``parallel.quant.reduce_payload_sum``), mutating the payload
+        NDArrays in place."""
+        return payloads
 
     def heartbeat(self):
         """Run one dist worker heartbeat outside a reduce batch.  The
@@ -592,12 +677,31 @@ class KVStore(object):
         self._updater = opt.get_updater(optimizer)
 
     def set_gradient_compression(self, compression_params):
-        """ref: kvstore.py set_gradient_compression (2bit only, like ref)."""
+        """ref: kvstore.py set_gradient_compression (2bit only, like ref).
+
+        DEPRECATED for the Trainer step: the threshold compressor only
+        ever rode the per-key serial wire (``push``), and forcing the
+        step onto that wire defeated the bucket schedulers.  Calling
+        this now routes ``Trainer.step``'s BUCKET reduces onto the
+        block-scaled quantized wire (graftzero, ``GRAFT_QUANT_REDUCE``
+        semantics with mode ``2bit``) while the per-key ``push`` API
+        keeps the exact legacy threshold algebra.  ``GRAFT_QUANT_REDUCE=0``
+        is the bit-identical escape hatch: it disables the bucket-wire
+        quantization entirely (the env var always wins)."""
         ctype = compression_params.get("type", "2bit")
         if ctype != "2bit":
             raise ValueError("Unsupported type of gradient compression: %s" % ctype)
+        import warnings
+        warnings.warn(
+            "set_gradient_compression is deprecated for the bucketed "
+            "Trainer step: bucket reduces now ride the block-scaled "
+            "quantized wire (GRAFT_QUANT_REDUCE=2bit semantics); the "
+            "per-key push API keeps the legacy threshold algebra. Set "
+            "GRAFT_QUANT_REDUCE=0 for the bit-identical escape hatch.",
+            DeprecationWarning, stacklevel=2)
         self._compressor = _TwoBitCompressor(
             compression_params.get("threshold", 0.5))
+        self._quant_override = "2bit"
 
     # -- distributed-only API (graceful single-process behavior) -----------
     def barrier(self):

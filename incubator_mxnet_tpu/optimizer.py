@@ -628,9 +628,34 @@ class Updater(object):
                 return s.asnumpy()
             if isinstance(s, (tuple, list)):
                 return type(s)(to_np(i) for i in s)
+            if hasattr(s, "shape") and hasattr(s, "dtype"):
+                # device arrays parked directly in the store (graftzero's
+                # error-feedback residuals) — persist as plain numpy so
+                # snapshots never pickle framework device buffers
+                return np.asarray(s)
             return s
         states = {k: to_np(v) for k, v in self.states.items()}
         return pickle.dumps((states, self.optimizer) if dump_optimizer else states)
+
+    def states_nbytes(self):
+        """Optimizer-state bytes this updater holds — a metadata walk
+        (shape x dtype, never forces a device flush) over the int-keyed
+        per-param states only; string-keyed side entries (graftzero's
+        error-feedback residuals) are wire state, not optimizer state,
+        and are counted by their own telemetry.  This is what the
+        ``graft_trainer_state_shard_bytes`` gauge reports: under ZeRO-1
+        sharding each rank's updater holds ~1/N of the unsharded total."""
+        def leaf_nbytes(s):
+            if isinstance(s, NDArray):
+                arr = s._read()
+                return int(np.dtype(arr.dtype).itemsize) * int(np.prod(arr.shape, dtype=np.int64))
+            if isinstance(s, np.ndarray):
+                return int(s.nbytes)
+            if isinstance(s, (tuple, list)):
+                return sum(leaf_nbytes(i) for i in s)
+            return 0
+        return sum(leaf_nbytes(v) for k, v in self.states.items()
+                   if isinstance(k, int))
 
 
 def get_updater(optimizer):

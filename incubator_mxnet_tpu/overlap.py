@@ -391,8 +391,15 @@ class BucketScheduler(object):
             flat = host._sched_flat(b)
             state["versions"] = [g._version for g in state["grads"]]
             state["flat"] = flat
-            state["handle"] = kv.reduce_many_async(
-                [flat], label=host._sched_label(b))
+            # graftzero: hosts with a quantized-wire hook (Trainer) issue
+            # the bucket through it — the scheduler itself is payload-
+            # agnostic and issues quantized buckets unchanged
+            issue = getattr(host, "_sched_reduce_async", None)
+            if issue is not None:
+                state["handle"] = issue(kv, b, flat)
+            else:
+                state["handle"] = kv.reduce_many_async(
+                    [flat], label=host._sched_label(b))
         self.issue_log.append((b.indices, self._fire_count))
         self.issued_total += 1
         # graftpulse memory timeline: the mid-backward issue is where a

@@ -653,7 +653,8 @@ class DistKVStore(KVStore):
             np.concatenate(ptr_parts)))
         return red
 
-    def _cross_worker_reduce_many(self, reds, heartbeat=True):
+    def _cross_worker_reduce_many(self, reds, heartbeat=True,
+                                  compress=False):
         """All values of one push in as few collectives as possible:
         same-dtype values pack into one flat buffer (native dtype, so
         integer sums stay exact) and go through ONE in-graph all-reduce —
@@ -676,7 +677,12 @@ class DistKVStore(KVStore):
         # so an N-value push costs 2 dispatches of host glue instead of
         # ~2N (one ravel per value + one slice per write-back)
         from .. import engine as _engine
-        compress = (self._compressor is not None)
+        # the legacy threshold compressor only applies to per-key PUSH
+        # traffic (the caller already quantized to {-t, 0, +t}); bucket
+        # flats from reduce_many* arrive compress=False — they either
+        # ride dense or went through the block-scaled graftzero wire
+        # (_cross_worker_reduce_quantized) before reaching a collective
+        compress = compress and (self._compressor is not None)
         for dtype, group in groups.items():
             vals = [r._read() for r in group]
             flat = _engine.flatten_arrays(tuple(vals))
@@ -713,6 +719,28 @@ class DistKVStore(KVStore):
         if heartbeat and _blackbox.enabled():
             self._heartbeat_skew()
         return reds
+
+    def _cross_worker_reduce_quantized(self, payloads, n_elems, mode,
+                                       block, heartbeat=True):
+        """graftzero: one EQuARX-style quantized collective per bucket
+        payload — all-to-all of the packed codes + scales shards,
+        per-shard dequant + f32 sum, re-quantize, narrow all-gather
+        (``parallel.quant.reduce_payload_sum``; no f32 collective).
+        Mutates the payload NDArrays in place; same heartbeat piggyback
+        contract as the dense reduce."""
+        if num_workers() <= 1 or not payloads:
+            return payloads
+        from . import quant as _quant
+        mesh = worker_mesh()
+        for (codes, scales), n in zip(payloads, n_elems):
+            oc, osc = _quant.reduce_payload_sum(
+                codes._read(), scales._read(), int(n), mode, int(block),
+                mesh)
+            codes._write(oc)
+            scales._write(osc)
+        if heartbeat and _blackbox.enabled():
+            self._heartbeat_skew()
+        return payloads
 
     def heartbeat(self):
         """One worker heartbeat on demand (the Trainer's overlapped-step

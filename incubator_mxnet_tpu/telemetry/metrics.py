@@ -436,6 +436,22 @@ def kvstore_pull(nbytes):
                       "Bytes pulled out of the kvstore").inc(nbytes)
 
 
+def trainer_state_shard_bytes(nbytes, n_shards):
+    """graftzero ZeRO-1 gauge: optimizer-state bytes this rank holds for
+    its shard (max over per-context updaters), plus the shard count —
+    the acceptance gate \"per-rank state ~1/N of unsharded\" reads the
+    pair straight off these."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    r.gauge("graft_trainer_state_shard_bytes",
+            "Optimizer-state bytes held for this rank's ZeRO-1 shard").set(
+        float(nbytes))
+    r.gauge("graft_trainer_state_shards",
+            "ZeRO-1 shard count (ranks/contexts owning state)").set(
+        float(n_shards))
+
+
 _io_rate = {}          # iterator name -> [last perf_counter, ewma rate]
 _io_lock = threading.Lock()
 

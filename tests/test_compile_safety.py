@@ -268,6 +268,7 @@ def _synthetic_key(**over):
         "n-ctx": 1,
         "kvstore-sig": None,
         "bucket-bytes": 4 << 20,
+        "quant-cfg": None,
     }
     base.update(over)
     return tuple(base[c] for c in GUARD_COMPONENTS)

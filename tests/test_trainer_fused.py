@@ -228,14 +228,19 @@ def test_fused_fallbacks():
     t.step(2)
     assert t._update_on_kvstore and t._fused_plan() is None
 
-    # gradient compression keeps per-key residual state: per-param path
+    # gradient compression no longer forces the per-key serial path: it
+    # routes the bucketed step onto the block-scaled quantized wire
+    # (graftzero), with a DeprecationWarning at store configuration
     p = _make_params("fb4", SPECS)
     _seed(p, weights, grads)
-    t = gluon.Trainer(p, "sgd", {"learning_rate": 0.01},
-                      kvstore=mx.kv.create("dist_sync"),
-                      compression_params={"type": "2bit"})
-    t.step(2)
-    assert t._fused_plan() is None
+    with pytest.warns(DeprecationWarning):
+        t = gluon.Trainer(p, "sgd", {"learning_rate": 0.01},
+                          kvstore=mx.kv.create("dist_sync"),
+                          compression_params={"type": "2bit"})
+        t.step(2)
+    assert t._fused_plan() is not None and t._fused_plan()[0]
+    from incubator_mxnet_tpu.parallel import quant
+    assert any(quant.is_residual_key(k) for k in t._updaters[0].states)
 
 
 def test_trainer_save_load_states_roundtrip_on_fused_path():

@@ -85,6 +85,15 @@
 #    opening no session; bench_eager --smoke (tier 3) additionally
 #    gates xray_overhead_pct (harness armed, no capture) against its
 #    < 2% budget in BENCH JSON.
+# 13. graftzero smoke — parallel.quant --selftest proves the block-scaled
+#    quantization kernels (int8/2bit encode/decode round-trips, the
+#    documented per-element error bounds, packed-field summability,
+#    wire-byte accounting, shard ownership maps, error-feedback
+#    convergence in exact arithmetic); bench_eager --smoke (tier 3)
+#    additionally gates the int8 wire-bytes ratio (>= 3.5x below f32),
+#    the GRAFT_QUANT_REDUCE=0 escape hatch (bit-identical, < 2%
+#    overhead) and the ZeRO-1 shard parity + ~1/N state-bytes claim via
+#    an 8-device child run.
 #
 # Usage: tools/run_lint.sh [report.json]
 set -uo pipefail
@@ -121,5 +130,8 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     || exit $?
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m incubator_mxnet_tpu.telemetry.xray --selftest \
+    || exit $?
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m incubator_mxnet_tpu.parallel.quant --selftest \
     || exit $?
 exec python -m incubator_mxnet_tpu.telemetry --selftest
