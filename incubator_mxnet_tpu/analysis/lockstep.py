@@ -46,7 +46,8 @@ from collections import deque
 
 __all__ = ["enabled", "set_enabled", "fold", "state", "state_lagged",
            "observe", "note_order", "divergence", "table", "snapshot",
-           "reset", "keys_digest", "lag", "EXCLUDED_PATHS", "TABLE_SIZE"]
+           "reset", "keys_digest", "lag", "EXCLUDED_PATHS", "TABLE_SIZE",
+           "fold_value", "epoch_base", "rebase", "epoch"]
 
 # host parameter-service RPCs are rank-asymmetric by design (async SGD)
 EXCLUDED_PATHS = frozenset(["ps_push", "ps_pull", "ps_push_async"])
@@ -91,6 +92,7 @@ _seen = {}                      # seq -> {rank: hash} from heartbeats
 _divergence = [None]            # first divergence report (latched)
 _order = {}                     # path -> next expected issue index
 _order_violations = []
+_epoch = [0]                    # membership epoch the stream is based on
 
 
 def _crc(text):
@@ -102,6 +104,31 @@ def keys_digest(keys):
     if not keys:
         return 0
     return _crc(",".join(str(k) for k in keys))
+
+
+def fold_value(rolling, fold_idx, path, n_keys=None, nbytes=None,
+               keys=None):
+    """The PURE fold step: combine one collective's identity into a
+    rolling int31 at stream position ``fold_idx`` (1-based).  This is
+    the exact arithmetic :func:`fold` applies to the module stream —
+    exposed so simulated ranks (elastic's single-process membership
+    harness) can maintain per-virtual-rank digests that are
+    bit-comparable with the real auditor's."""
+    digest = _crc("%s|%s|%s|%s" % (path, n_keys, nbytes,
+                                   keys_digest(keys)))
+    return (int(rolling) * _PRIME + digest + int(fold_idx)) & 0x7fffffff
+
+
+def epoch_base(epoch):
+    """The rolling-hash SEED of membership epoch ``epoch``.  Epoch 0
+    (the launch membership) seeds at 0 — the pre-elastic stream is
+    unchanged byte-for-byte; later epochs seed on the epoch number so a
+    stream that re-based and one that did not can never accidentally
+    agree (a rank that missed the re-partition is named immediately,
+    not after the next real divergence)."""
+    if not epoch:
+        return 0
+    return _crc("membership-epoch|%d" % int(epoch))
 
 
 def fold(seq, path, n_keys=None, nbytes=None, keys=None):
@@ -378,6 +405,36 @@ def note_order(path, issue_idx):
     return ok
 
 
+def epoch():
+    """The membership epoch the current fold stream is based on."""
+    return _epoch[0]
+
+
+def rebase(new_epoch):
+    """Re-base the fold stream at a membership-epoch boundary
+    (graftelastic).  Every surviving rank calls this at the SAME stream
+    position (behind the repartition step barrier), so the divergence
+    contract holds ACROSS epochs: pre-epoch history — the divergence
+    table, the cross-rank ``_seen`` observations, the fold counter —
+    is dropped (a departed rank's stale hashes must not be compared
+    against the re-based stream, and survivors' fold counts restart
+    together), and the rolling hash re-seeds on :func:`epoch_base` so
+    epoch N and epoch M streams can never accidentally match.  A
+    latched divergence report is KEPT — it is evidence, not state.
+    Per-path issue-order counters also restart: the duplex background
+    wire drains before a re-partition (``DistKVStore.quiesce``), so
+    post-epoch issue indices legitimately begin at 0 again."""
+    with _lock:
+        _epoch[0] = int(new_epoch)
+        _rolling[0] = epoch_base(new_epoch)
+        _folds[0] = 0
+        _last_wire_seq[0] = 0
+        _table.clear()
+        _seen.clear()
+        _order.clear()
+    return _rolling[0]
+
+
 def snapshot():
     """Dump-embeddable auditor state (blackbox.snapshot folds this into
     every flight-recorder dump, so a watchdog hang dump carries the
@@ -386,6 +443,7 @@ def snapshot():
     return {"enabled": enabled(), "folds": folds,
             "last_wire_seq": _last_wire_seq[0],
             "rolling_hash": rolling, "divergence": _divergence[0],
+            "epoch": _epoch[0],
             "order_violations": list(_order_violations),
             "table": table(last=64)}
 
@@ -401,3 +459,4 @@ def reset():
         _divergence[0] = None
         _order.clear()
         del _order_violations[:]
+        _epoch[0] = 0

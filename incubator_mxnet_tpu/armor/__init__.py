@@ -19,12 +19,13 @@ from __future__ import annotations
 
 from .errors import (ArmorError, FaultInjectedError, PSUnavailableError,
                      CollectiveTimeoutError, CheckpointCorruptError,
-                     ShardOwnershipError)
+                     ShardOwnershipError, MembershipChangedError,
+                     QuiesceTimeoutError)
 from .faults import fault_point, configure, reset, active_rules, set_rank
 
 __all__ = [
     "ArmorError", "FaultInjectedError", "PSUnavailableError",
     "CollectiveTimeoutError", "CheckpointCorruptError",
-    "ShardOwnershipError",
+    "ShardOwnershipError", "MembershipChangedError", "QuiesceTimeoutError",
     "fault_point", "configure", "reset", "active_rules", "set_rank",
 ]

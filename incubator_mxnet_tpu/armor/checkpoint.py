@@ -195,6 +195,12 @@ def snapshot_trainer(trainer, step, extra=None):
         params[p.name] = np.asarray(p.list_data()[0]._read())
     shard = getattr(trainer, "_zero_spec", None)
     shard = shard() if callable(shard) else None
+    member = getattr(trainer, "_membership", None)
+    if member is not None:
+        epoch = int(member.epoch)
+    else:
+        from ..analysis import lockstep as _lockstep
+        epoch = int(_lockstep.epoch())
     state = {
         "format": FORMAT,
         "step": int(step),
@@ -202,6 +208,7 @@ def snapshot_trainer(trainer, step, extra=None):
         "optimizer": None if shard else _updater_states(trainer),
         "rng": _random_state.get_state(),
         "saved_at": time.time(),
+        "membership_epoch": epoch,
         "extra": dict(extra or {}),
     }
     if shard is not None:
@@ -226,11 +233,25 @@ def restore_trainer(trainer, state):
     saved_shard = state.get("shard")
     cur = getattr(trainer, "_zero_spec", None)
     cur_shard = cur() if callable(cur) else None
+    repartition = False
     if (saved_shard or None) != (dict(cur_shard) if cur_shard else None):
-        # refuse BEFORE touching anything: a sharded snapshot on an
-        # unsharded trainer (or vice versa, or a different rank/shard
-        # count) would restore at most one shard's optimizer state
-        raise ShardOwnershipError(saved_shard, cur_shard)
+        from .. import elastic as _elastic
+        same_axis = (saved_shard is not None and cur_shard is not None
+                     and saved_shard.get("axis") == cur_shard.get("axis"))
+        if _elastic.enabled() and same_axis:
+            # graftelastic: the world size changed across a membership
+            # epoch — re-partition the shard blobs deterministically
+            # instead of refusing.  Ownership under ZeRO-1 is lazy
+            # (sync_state_context rehydrates only the indices the NEW
+            # shard map assigns each updater), so the merged state dict
+            # restores safely on every updater.
+            repartition = True
+        else:
+            # refuse BEFORE touching anything: a sharded snapshot on an
+            # unsharded trainer (or vice versa, or a changed shard AXIS)
+            # would restore at most one shard's optimizer state
+            raise ShardOwnershipError(saved_shard, cur_shard,
+                                      epoch=state.get("membership_epoch"))
     params = state.get("params", {})
     by_name = {p.name: p for p in trainer._params}
     missing = sorted(set(by_name) - set(params))
@@ -249,6 +270,10 @@ def restore_trainer(trainer, state):
                                       d._read()))
     if saved_shard is not None:
         shards = state.get("optimizer_shards") or []
+        if repartition:
+            from ..elastic.membership import repartition_shard_states
+            shards = repartition_shard_states(shards,
+                                              len(trainer._updaters))
         if len(shards) != len(trainer._updaters):
             raise CheckpointCorruptError(
                 "<state>", "snapshot has %d optimizer shards, trainer "

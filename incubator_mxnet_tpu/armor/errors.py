@@ -11,7 +11,8 @@ circular-import risk.
 
 __all__ = ["ArmorError", "FaultInjectedError", "PSUnavailableError",
            "CollectiveTimeoutError", "CheckpointCorruptError",
-           "ShardOwnershipError"]
+           "ShardOwnershipError", "MembershipChangedError",
+           "QuiesceTimeoutError"]
 
 
 class ArmorError(RuntimeError):
@@ -88,16 +89,66 @@ class ShardOwnershipError(ArmorError):
     with different shard counts/axes.  Optimizer state is partitioned
     by bucket ownership, so silently restoring across layouts would
     leave most shards untrained; the saved and current specs travel in
-    ``.saved`` / ``.current`` for supervisors to reconcile."""
+    ``.saved`` / ``.current`` for supervisors to reconcile.  When the
+    mismatch crosses a graftelastic membership epoch, ``.epoch`` names
+    the snapshot's epoch (restore across a changed world size is only
+    legal with GRAFT_ELASTIC=1, which re-partitions deterministically
+    instead of raising this)."""
 
-    def __init__(self, saved, current):
+    def __init__(self, saved, current, epoch=None):
         def _fmt(spec):
             if not spec:
                 return "unsharded"
             return "%s-sharded n=%s" % (spec.get("axis"), spec.get("n"))
-        super().__init__(
-            "shard layout mismatch: snapshot is %s but this trainer is "
-            "%s — re-launch with the snapshot's GRAFT_SHARD_OPTIMIZER "
-            "topology (or retrain)" % (_fmt(saved), _fmt(current)))
+        msg = ("shard layout mismatch: snapshot is %s but this trainer is "
+               "%s — re-launch with the snapshot's GRAFT_SHARD_OPTIMIZER "
+               "topology (or retrain)" % (_fmt(saved), _fmt(current)))
+        if epoch is not None:
+            msg += ("; snapshot was taken at membership epoch %d — set "
+                    "GRAFT_ELASTIC=1 to re-partition shard state across "
+                    "the epoch boundary" % int(epoch))
+        super().__init__(msg)
         self.saved = dict(saved) if saved else None
         self.current = dict(current) if current else None
+        self.epoch = None if epoch is None else int(epoch)
+
+
+class MembershipChangedError(ArmorError):
+    """The cluster membership moved under a caller (graftelastic): a
+    collective, rejoin stream, or barrier observed a membership epoch
+    other than its own — the world it was issued against no longer
+    exists.  Carries both epochs plus the departed/joined rank sets so
+    a supervisor can quiesce, re-partition, and retry at the new epoch
+    instead of mispairing the wire."""
+
+    def __init__(self, old_epoch, new_epoch, departed=(), joined=(),
+                 detail=None):
+        msg = ("membership changed: epoch %d -> %d"
+               % (int(old_epoch), int(new_epoch)))
+        if departed:
+            msg += "; departed ranks: %s" % sorted(departed)
+        if joined:
+            msg += "; joined ranks: %s" % sorted(joined)
+        if detail:
+            msg += " (%s)" % (detail,)
+        super().__init__(msg)
+        self.old_epoch = int(old_epoch)
+        self.new_epoch = int(new_epoch)
+        self.departed = tuple(sorted(departed))
+        self.joined = tuple(sorted(joined))
+        self.detail = detail
+
+
+class QuiesceTimeoutError(CollectiveTimeoutError):
+    """``DistKVStore.quiesce()`` could not drain the in-flight async
+    pushes/pulls within its budget — the duplex wire is stuck (dead
+    server, hung RPC), so a re-partition that remapped key ranges now
+    would race the stale traffic.  A :class:`CollectiveTimeoutError`
+    subtype: the same supervisors that handle watchdog escalation
+    handle this."""
+
+    def __init__(self, site, age_s, timeout_s, pending=0, dead_ranks=()):
+        super().__init__(site, age_s, timeout_s, dead_ranks=dead_ranks,
+                         detail="%d in-flight operation%s undrained"
+                         % (pending, "" if pending == 1 else "s"))
+        self.pending = int(pending)

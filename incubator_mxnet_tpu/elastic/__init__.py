@@ -1,0 +1,75 @@
+"""graftelastic — live membership change for the dist training fleet.
+
+graftarmor (PR 15) made a dead rank a *detectable, typed* event; this
+package makes it a *survivable* one.  Three pieces (ISSUE 20 /
+docs/robustness.md "Elasticity"):
+
+* :mod:`.membership` — epoch-fenced membership: a deterministic
+  :class:`MembershipView` per epoch, a :class:`Membership` state
+  machine that applies queued changes behind the Trainer's step
+  barrier (quiesce the duplex wire, re-partition PS key ranges and
+  ZeRO ``shard_owners``, rebuild bucket plans, re-base the lockstep
+  auditor's fold stream), and pure re-partition helpers whose outputs
+  depend only on ``(keys, world_size)`` — every survivor computes the
+  same maps with no coordinator.
+* :mod:`.rejoin` — checkpoint-streamed rejoin: a replacement rank
+  pulls the newest VALIDATED armor snapshot (params + optimizer-shard
+  blobs + ``__quant_ef__`` residuals — everything the checkpointer
+  already captures) over the PS wire in buckets, validates the
+  manifest hash, restores, and joins at the next epoch fence.
+* :mod:`.harness` — a single-process simulated-N-rank cluster
+  (virtual ranks, a shard-ordered deterministic reduce wire, real
+  ``Membership`` objects per rank) so kill → re-partition → rejoin →
+  byte-parity runs as REAL coverage in one process, no multi-host
+  cluster required.
+
+Master switch ``GRAFT_ELASTIC`` (default off — bit-identical inert:
+the only enabled-path cost on a quiet step is one memoized env read
+plus an empty-queue check, gated < 2% by ``bench_eager.py --smoke``).
+Like every collective-shape switch (``GRAFT_BLACKBOX``,
+``GRAFT_LOCKSTEP_CHECK``) set it IDENTICALLY on every rank: the dist
+heartbeat vector grows a membership-epoch block when it is on.
+
+``python -m incubator_mxnet_tpu.elastic --selftest`` proves the
+kill → re-partition → rejoin → byte-parity loop (lint tier 14).
+"""
+from __future__ import annotations
+
+import os
+
+from .membership import (MembershipView, Membership, key_owner,
+                         repartition_plan, repartition_shard_states,
+                         merge_shard_states)
+from .rejoin import (InProcessByteStore, stream_snapshot, fetch_snapshot,
+                     rejoin_trainer, rejoin_timeout)
+
+__all__ = [
+    "enabled", "set_enabled",
+    "MembershipView", "Membership", "key_owner", "repartition_plan",
+    "repartition_shard_states", "merge_shard_states",
+    "InProcessByteStore", "stream_snapshot", "fetch_snapshot",
+    "rejoin_trainer", "rejoin_timeout",
+]
+
+_enabled_override = None
+_cache = [None, False]          # (raw env string, verdict) — hot-path memo
+
+
+def set_enabled(flag):
+    """Force elastic on/off (None = defer to GRAFT_ELASTIC)."""
+    global _enabled_override
+    _enabled_override = flag
+
+
+def enabled():
+    """GRAFT_ELASTIC (default off), memoized on the raw string — this
+    sits on Trainer.step's hot path, so the steady-state cost is one
+    dict lookup and a pointer compare."""
+    if _enabled_override is not None:
+        return bool(_enabled_override)
+    raw = os.environ.get("GRAFT_ELASTIC")
+    if raw != _cache[0]:
+        _cache[0] = raw
+        _cache[1] = (raw or "").strip().lower() in ("1", "on", "true",
+                                                    "yes")
+    return _cache[1]

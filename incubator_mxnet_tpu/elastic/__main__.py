@@ -1,0 +1,410 @@
+"""graftelastic CLI.
+
+    python -m incubator_mxnet_tpu.elastic --selftest
+        Lint smoke tier for live membership change:
+
+        * membership algebra — view advance is pure and epoch-monotonic,
+          the re-partition key plan is deterministic and minimal, and
+          ``key_owner`` agrees with the PS wire's placement hash;
+        * kill + rejoin byte parity — a simulated 3-rank cluster loses a
+          rank mid-training and streams it back in via an armor
+          snapshot; the faulted run's loss trajectory and final params
+          are BYTE-identical to the unfaulted baseline and the virtual
+          lockstep digests agree across >= 2 membership epochs;
+        * PS-wire snapshot stream — against a REAL ParameterServer +
+          PSClient pair: a chunked snapshot round-trips bit-exactly and
+          a mangled stream raises typed ``CheckpointCorruptError``;
+        * chaos determinism — seeded ``membership.join`` /
+          ``membership.repartition`` faults replay identically; a
+          dropped re-partition leaves the rank on the OLD epoch (the
+          divergence the lockstep auditor names); a stream that never
+          appears raises ``CollectiveTimeoutError`` in budget; a stuck
+          quiesce raises ``QuiesceTimeoutError`` naming the pending
+          count;
+        * shard re-partition across world sizes — a ZeRO snapshot
+          restores onto a DIFFERENT shard count when GRAFT_ELASTIC=1
+          (deterministic merge) and refuses with a typed
+          ``ShardOwnershipError`` naming the epoch when off — both
+          grow and shrink directions;
+        * inertness — GRAFT_ELASTIC=0 leaves ``enabled()`` false and the
+          step fence untaken.
+
+        Exit 1 on any regression.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_ENV_KEYS = ("GRAFT_ELASTIC", "GRAFT_FAULTS", "GRAFT_REJOIN_TIMEOUT",
+             "GRAFT_QUIESCE_TIMEOUT", "GRAFT_BUCKET_BYTES",
+             "GRAFT_SHARD_OPTIMIZER")
+
+
+def _membership_algebra(check):
+    import zlib
+    from .membership import (MembershipView, key_owner, repartition_plan,
+                             merge_shard_states, repartition_shard_states)
+
+    v0 = MembershipView(0, range(4))
+    check(v0.world_size == 4 and v0.ranks == (0, 1, 2, 3),
+          "launch view must hold the sorted launch ranks")
+    v1 = v0.advance(departed=[2])
+    check(v1.epoch == 1 and v1.ranks == (0, 1, 3)
+          and v1.departed == (2,),
+          "advance(departed) must drop the rank and bump the epoch")
+    v2 = v1.advance(joined=[2])
+    check(v2.epoch == 2 and v2.ranks == (0, 1, 2, 3),
+          "advance(joined) must restore the rank at the NEXT epoch")
+    check(v0.advance(departed=[2]) == v1,
+          "advance must be pure: equal inputs, equal views")
+    try:
+        MembershipView(0, [7]).advance(departed=[7])
+        check(False, "a change leaving zero ranks must raise")
+    except ValueError:
+        pass
+
+    keys = ["w%d" % i for i in range(32)]
+    check(key_owner("w3", 4) == zlib.crc32(b"w3") % 4,
+          "key_owner must be the PS wire's crc32 placement hash")
+    plan_a = repartition_plan(keys, 4, 3)
+    plan_b = repartition_plan(list(reversed(keys)), 4, 3)
+    check(plan_a == plan_b,
+          "the re-partition plan must not depend on key iteration order")
+    plan, moved = plan_a
+    check(all(plan[k][0] != plan[k][1] for k in moved)
+          and all(plan[k][0] == plan[k][1]
+                  for k in keys if k not in moved),
+          "moved must be EXACTLY the keys whose owner changed")
+    _, same = repartition_plan(keys, 4, 4)
+    check(same == [], "an unchanged group size must move nothing")
+
+    import pickle
+    a = pickle.dumps(({0: "s0", "__quant_ef__/f32:0": "r0"}, "OPT"))
+    b = pickle.dumps(({1: "s1"}, None))
+    merged, opt = merge_shard_states([a, b])
+    check(merged == {0: "s0", 1: "s1", "__quant_ef__/f32:0": "r0"}
+          and opt == "OPT",
+          "merge must be the disjoint union and keep the optimizer")
+    blobs = repartition_shard_states([a, b], 3)
+    check(len(blobs) == 3 and len(set(blobs)) == 1
+          and blobs == repartition_shard_states([a, b], 3),
+          "re-partition must hand every new updater one identical "
+          "deterministic merged blob")
+
+
+def _parity(check):
+    from .harness import SimulatedCluster
+
+    base = SimulatedCluster(3).run(6)
+    check(base.digests_agree(),
+          "unfaulted baseline must keep one digest per step")
+
+    c = SimulatedCluster(3)
+    c.run(2)
+    c.kill(1)
+    c.run(2)
+    c.rejoin(1)
+    c.run(2)
+    check(sorted(c.epochs_seen) == [0, 1, 2],
+          "kill + rejoin must fence exactly two membership epochs "
+          "(got %r)" % sorted(c.epochs_seen))
+    check(c.digests_agree(),
+          "virtual lockstep digests must agree on every step across "
+          "the membership epochs (zero divergence)")
+    check(c.loss_trajectory == base.loss_trajectory,
+          "the faulted run's loss trajectory must be byte-identical "
+          "to the unfaulted baseline")
+    check(base.params_bytes() == c.params_bytes(),
+          "final params must be byte-identical to the baseline")
+    check(c.params_bytes(1) == c.params_bytes(0),
+          "the rejoined rank must hold the survivors' exact bytes")
+
+
+def _ps_stream(check):
+    from ..parallel import ps
+    from ..armor import checkpoint as ckpt
+    from ..armor.errors import CheckpointCorruptError
+    from .harness import SimulatedCluster
+    from .rejoin import stream_snapshot, fetch_snapshot, _keys
+
+    cluster = SimulatedCluster(2).run(1)
+    donor = cluster.live[0]
+    state = ckpt.snapshot_trainer(donor.trainer, cluster.step_count)
+
+    srv = ps.ParameterServer(host="127.0.0.1")
+    client = ps.PSClient(srv.address)
+    fd, tmp = tempfile.mkstemp(suffix=".armor")
+    os.close(fd)
+    try:
+        ckpt.save_state(tmp, state)
+        raw_want = open(tmp, "rb").read()
+        os.environ["GRAFT_BUCKET_BYTES"] = str(64 << 10)   # force chunking
+        manifest = stream_snapshot(client, tmp, "wire-test")
+        check(manifest["nbytes"] == len(raw_want),
+              "stream manifest must carry the exact payload size")
+        raw_got = fetch_snapshot(client, "wire-test", timeout=5.0)
+        check(raw_got == raw_want,
+              "a PS-wire streamed snapshot must round-trip bit-exactly")
+
+        # mangled stream: good manifest, torn chunk bytes
+        import json
+        mkey, ckeys = _keys("wire-torn", 1)
+        client.init({ckeys[0]: np.frombuffer(raw_want[:-8], np.uint8)})
+        client.init({mkey: np.frombuffer(json.dumps(
+            {"nchunks": 1, "nbytes": len(raw_want),
+             "sha256": manifest["sha256"], "tag": "wire-torn"},
+            sort_keys=True).encode(), np.uint8)})
+        try:
+            fetch_snapshot(client, "wire-torn", timeout=5.0)
+            check(False, "a torn stream must not validate")
+        except CheckpointCorruptError:
+            pass
+    finally:
+        os.environ.pop("GRAFT_BUCKET_BYTES", None)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        client.close()
+        srv.shutdown()
+
+
+def _chaos(check):
+    from ..armor import faults
+    from ..armor.errors import (CollectiveTimeoutError, FaultInjectedError,
+                                QuiesceTimeoutError)
+    from .membership import Membership, MembershipView
+    from .rejoin import InProcessByteStore, fetch_snapshot
+
+    # a stream that never appears: typed timeout inside the budget
+    faults.configure("membership.join:drop")
+    t0 = time.perf_counter()
+    try:
+        fetch_snapshot(InProcessByteStore(), "never", timeout=0.3)
+        check(False, "an absent stream must raise the typed timeout")
+    except CollectiveTimeoutError as exc:
+        check(exc.site == "membership.join" and exc.timeout_s == 0.3,
+              "stream timeout must name the join site and its budget")
+    check(time.perf_counter() - t0 < 5.0,
+          "the join poll must respect its budget, not spin forever")
+
+    # seeded join chaos replays identically
+    def join_verdicts(n):
+        faults.configure("membership.join:error:p=0.5:seed=11:times=100")
+        out = []
+        store = InProcessByteStore()
+        store.init({"__elastic__/snap/t/manifest": np.zeros(1, np.uint8)})
+        for _ in range(n):
+            try:
+                faults.fault_point("membership.join", tag="t")
+                out.append(False)
+            except FaultInjectedError:
+                out.append(True)
+        return out
+    seq = join_verdicts(16)
+    check(seq == join_verdicts(16) and any(seq) and not all(seq),
+          "seeded membership.join chaos must replay deterministically")
+
+    # a dropped re-partition leaves the rank on the OLD epoch — the
+    # divergence the lockstep auditor names
+    faults.configure("membership.repartition:drop:times=1")
+    launch = MembershipView(0, range(3))
+    lag, ok = Membership(0, view=launch), Membership(2, view=launch)
+    for m in (lag, ok):
+        m.request_change(departed=[1])
+    lag.apply_pending()
+    ok.apply_pending()
+    check(lag.epoch == 0 and ok.epoch == 1,
+          "a dropped re-partition must leave ONLY that rank on the old "
+          "epoch (got %d/%d)" % (lag.epoch, ok.epoch))
+    faults.reset()
+    lag.apply_pending()
+    check(lag.epoch == 0 and not lag.pending(),
+          "the dropped change must not replay later on its own")
+
+    # a stuck duplex wire: quiesce raises typed, keeps ownership
+    from concurrent.futures import Future
+    from ..parallel.dist import DistKVStore
+    kv = object.__new__(DistKVStore)
+    stuck = Future()
+    kv._push_futs = [stuck]
+    kv._pull_pool = None
+    try:
+        kv.quiesce(timeout=0.05)
+        check(False, "an undrainable wire must raise QuiesceTimeoutError")
+    except QuiesceTimeoutError as exc:
+        check(exc.pending == 1 and exc.site == "kvstore.quiesce",
+              "quiesce timeout must name the site and pending count")
+    check(kv._push_futs == [stuck],
+          "undrained futures must stay owned after a quiesce timeout")
+    stuck.set_result(None)
+    check(kv.quiesce(timeout=1.0) == 1 and kv._push_futs == [],
+          "a settled wire must drain and report the drained count")
+
+
+def _trainer(seed=3):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+    from .. import random_state
+    random_state.seed(seed)
+    net = gluon.nn.Dense(4, prefix="elastic_selftest_")
+    net.initialize(ctx=mx.cpu())
+    rs = np.random.RandomState(seed)
+    net(mx.nd.array(rs.randn(2, 6).astype(np.float32)))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    return net, trainer, rs
+
+
+def _step(net, trainer, rs):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+    x = mx.nd.array(rs.randn(2, 6).astype(np.float32))
+    with autograd.record():
+        loss = (net(x) * net(x)).sum()
+    loss.backward()
+    trainer.step(2)
+
+
+def _shard_repartition(check):
+    from . import set_enabled
+    from ..armor import checkpoint as ckpt
+    from ..armor.errors import ShardOwnershipError
+
+    def snap_with_spec(n, rank):
+        net, trainer, rs = _trainer()
+        _step(net, trainer, rs)      # momentum state materializes
+        trainer._zero_spec = lambda: {"axis": "ctx", "n": n, "rank": rank}
+        return net, trainer, ckpt.snapshot_trainer(trainer, 7)
+
+    for old_n, new_n in ((2, 4), (4, 2)):     # grow AND shrink
+        _, _, state = snap_with_spec(old_n, 0)
+        check(state.get("shard", {}).get("n") == old_n
+              and "membership_epoch" in state,
+              "a ZeRO snapshot must carry its shard spec and epoch")
+        net2, t2, rs2 = _trainer()
+        t2._zero_spec = lambda: {"axis": "ctx", "n": new_n, "rank": 1}
+        set_enabled(False)
+        try:
+            ckpt.restore_trainer(t2, state)
+            check(False, "restore across %d->%d shards with elastic OFF "
+                  "must refuse" % (old_n, new_n))
+        except ShardOwnershipError as exc:
+            check(exc.epoch is not None
+                  and "GRAFT_ELASTIC" in str(exc),
+                  "the refusal must name the snapshot epoch and the "
+                  "GRAFT_ELASTIC remedy")
+        set_enabled(True)
+        step = ckpt.restore_trainer(t2, state)
+        check(step == 7,
+              "restore across %d->%d shards with elastic ON must "
+              "re-partition and land on the saved step" % (old_n, new_n))
+        want = {n: np.asarray(p.data()._read()).tobytes()
+                for n, p in net2.collect_params().items()}
+        _, _, state_b = snap_with_spec(old_n, 0)
+        net3, t3, _ = _trainer()
+        t3._zero_spec = lambda: {"axis": "ctx", "n": new_n, "rank": 1}
+        ckpt.restore_trainer(t3, state_b)
+        check({n: np.asarray(p.data()._read()).tobytes()
+               for n, p in net3.collect_params().items()} == want,
+              "the elastic re-partition must be deterministic "
+              "(two replays, identical bytes)")
+    set_enabled(None)
+
+
+def _inert(check):
+    from . import enabled, set_enabled
+    os.environ.pop("GRAFT_ELASTIC", None)
+    set_enabled(None)
+    check(enabled() is False,
+          "GRAFT_ELASTIC unset must leave elastic off")
+    os.environ["GRAFT_ELASTIC"] = "1"
+    check(enabled() is True, "GRAFT_ELASTIC=1 must enable elastic")
+    os.environ["GRAFT_ELASTIC"] = "0"
+    check(enabled() is False, "GRAFT_ELASTIC=0 must disable elastic")
+    set_enabled(True)
+    check(enabled() is True, "set_enabled must override the env")
+    set_enabled(None)
+
+    # the step fence: a pending change on a DISABLED trainer must not
+    # apply inside step() (bit-identical inert contract)
+    from .membership import Membership
+    net, trainer, rs = _trainer()
+    m = Membership(0, world_size=3)
+    trainer.attach_membership(m)
+    m.request_change(departed=[2])
+    _step(net, trainer, rs)
+    check(m.epoch == 0 and m.pending(),
+          "with elastic OFF, step() must not touch the pending change")
+    set_enabled(True)
+    _step(net, trainer, rs)
+    check(m.epoch == 1 and not m.pending(),
+          "with elastic ON, step() must fence the pending change")
+    set_enabled(None)
+
+
+def selftest():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from ..analysis import lockstep
+    from ..armor import faults
+    from ..telemetry import blackbox
+
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+            print("graftelastic selftest FAIL: %s" % msg, file=sys.stderr)
+
+    saved = {k: os.environ.get(k) for k in _ENV_KEYS}
+    prev_enabled = blackbox._enabled_override
+    blackbox.set_enabled(True)
+    try:
+        _membership_algebra(check)
+        _parity(check)
+        _ps_stream(check)
+        _chaos(check)
+        _shard_repartition(check)
+        _inert(check)
+    finally:
+        faults.reset()
+        lockstep.reset()
+        blackbox.set_enabled(prev_enabled)
+        from . import set_enabled
+        set_enabled(None)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    if failures:
+        print("graftelastic selftest: %d failure(s)" % len(failures),
+              file=sys.stderr)
+        return 1
+    print("graftelastic selftest OK (membership algebra pure, kill+rejoin "
+          "byte parity across 2 epochs, PS-wire stream validated, chaos "
+          "deterministic + typed timeouts, shard re-partition both "
+          "directions, GRAFT_ELASTIC=0 inert)")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="python -m incubator_mxnet_tpu.elastic")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    ap.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

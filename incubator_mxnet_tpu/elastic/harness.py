@@ -1,0 +1,255 @@
+"""Single-process simulated-N-rank membership harness (graftelastic).
+
+The container this repo grows in has no multi-host CPU collective
+transport, and the ROADMAP forbids shipping a dist feature whose only
+"coverage" is a SKIP-MULTIPROC sentinel.  This harness gives elastic
+logic REAL coverage in one process: ``n`` **virtual ranks**, each with
+its own parameter replicas, its own ``gluon.Trainer``, its own
+:class:`~.membership.Membership` state machine, and its own lockstep
+fold stream (maintained through the auditor's pure
+:func:`~..analysis.lockstep.fold_value` arithmetic, so the digests are
+bit-comparable with the real module stream).
+
+Determinism model — the property the byte-parity gate rests on: the
+global batch is split into fixed **data shards**; shard → rank
+ownership is a pure function of the membership view
+(``view.ranks[shard % world_size]``), and the simulated allreduce sums
+per-shard gradients **in shard-id order, never rank order**.  A
+membership change moves WHO computes a shard, not WHAT is summed or in
+what order — so a run that loses and regains a rank mid-training
+reproduces the unfaulted run's loss trajectory byte-for-byte.  That is
+the same discipline the real wire keeps (bucket content and issue
+order are functions of the plan, not the rank), enforced here exactly.
+
+Kill is abrupt (the rank object is dropped, as ``os._exit`` would);
+survivors queue the departure and apply it behind the next step fence.
+Rejoin streams a fresh armor snapshot through a byte store (the
+in-process one by default; a real ``PSClient`` works verbatim — the
+selftest runs one) and the joiner adopts the fence view.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+from . import membership as _membership
+from . import rejoin as _rejoin
+from ..analysis import lockstep as _lockstep
+
+__all__ = ["SimulatedRank", "SimulatedCluster", "shard_owner"]
+
+
+def shard_owner(shard, view):
+    """The live rank owning data shard ``shard`` under ``view`` — pure
+    in ``(shard, view)``, so every survivor derives the same map."""
+    return view.ranks[int(shard) % view.world_size]
+
+
+class SimulatedRank(object):
+    """One virtual rank: net + trainer + membership + fold stream."""
+
+    def __init__(self, rid, cluster):
+        self.rid = int(rid)
+        self.net, self.trainer = cluster._build()
+        self.membership = _membership.Membership(
+            rank=rid, view=cluster.launch_view)
+        self.folds = 0
+        self.rolling = _lockstep.epoch_base(cluster.launch_view.epoch)
+        self.trainer.attach_membership(self.membership)
+
+    # -- the virtual auditor stream -----------------------------------------
+    def fold(self, path, n_keys, nbytes):
+        self.folds += 1
+        self.rolling = _lockstep.fold_value(self.rolling, self.folds,
+                                            path, n_keys, nbytes)
+        return self.rolling
+
+    def rebase(self, epoch):
+        self.folds = 0
+        self.rolling = _lockstep.epoch_base(epoch)
+
+    def digest(self):
+        return (self.membership.epoch, self.folds, self.rolling)
+
+
+class SimulatedCluster(object):
+    """``n`` virtual ranks stepping one replicated model in lockstep.
+
+    ``step()`` runs one fenced training step: apply queued membership
+    changes, compute per-shard gradients on their owners, sum them in
+    shard order (the simulated allreduce), fold the collective into
+    every live rank's auditor stream, and apply the identical update on
+    every replica.  ``kill``/``rejoin`` drive membership changes; the
+    loss-trajectory bytes and per-step digests accumulate on the
+    instance for parity assertions."""
+
+    def __init__(self, n_ranks, batch=2, dim=6, units=4, n_shards=None,
+                 model_seed=11, data_seed=23, lr=0.1, momentum=0.9):
+        self.n0 = int(n_ranks)
+        self.batch = int(batch)
+        self.dim = int(dim)
+        self.units = int(units)
+        self.n_shards = int(n_shards) if n_shards else 2 * self.n0
+        self.model_seed = int(model_seed)
+        self.lr = lr
+        self.momentum = momentum
+        self.launch_view = _membership.MembershipView(0, range(self.n0))
+        self._data = np.random.RandomState(int(data_seed))
+        self.step_count = 0
+        self.loss_trajectory = []       # raw float32 bytes per step
+        self.digest_history = []        # per-step tuple of rank digests
+        self.epochs_seen = set([0])
+        self.live = {}
+        for rid in range(self.n0):
+            self.live[rid] = SimulatedRank(rid, self)
+
+    # -- model construction -------------------------------------------------
+    def _build(self):
+        """One deterministic replica: global-RNG-seeded init, so every
+        rank (and every run) starts from identical bytes."""
+        import incubator_mxnet_tpu as mx
+        from incubator_mxnet_tpu import gluon
+        from .. import random_state
+        random_state.seed(self.model_seed)
+        # fixed prefix: gluon's global name counter would otherwise give
+        # each replica different param names, and a streamed snapshot
+        # restores BY NAME
+        net = gluon.nn.Dense(self.units, prefix="elastic_dense_")
+        net.initialize(ctx=mx.cpu())
+        net(mx.nd.array(np.zeros((self.batch, self.dim), np.float32)))
+        trainer = gluon.Trainer(
+            net.collect_params(), "sgd",
+            {"learning_rate": self.lr, "momentum": self.momentum})
+        return net, trainer
+
+    def params_bytes(self, rid=None):
+        rank = self.live[rid if rid is not None
+                         else min(self.live)]
+        return {name: np.asarray(p.data()._read()).tobytes()
+                for name, p in rank.net.collect_params().items()}
+
+    # -- membership ---------------------------------------------------------
+    def view(self):
+        return self.live[min(self.live)].membership.view
+
+    def kill(self, rid):
+        """Abrupt rank death: the rank is gone NOW; survivors learn at
+        the next step fence (the dead-node table naming it)."""
+        del self.live[rid]
+        for r in self.live.values():
+            r.membership.request_change(departed=[rid])
+
+    def rejoin(self, rid, store=None):
+        """Checkpoint-streamed rejoin of ``rid``: a survivor snapshots
+        at the fence, streams it through ``store`` (the in-process byte
+        store unless a PSClient-shaped one is given), the replacement
+        restores + adopts the fence view, and survivors queue the join
+        for their next fence."""
+        from ..armor import checkpoint as _ckpt
+        store = store if store is not None else _rejoin.InProcessByteStore()
+        donor = self.live[min(self.live)]
+        fence = donor.membership.view.advance(joined=[rid])
+        state = _ckpt.snapshot_trainer(donor.trainer, self.step_count)
+        fd, tmp = tempfile.mkstemp(suffix=".armor")
+        os.close(fd)
+        try:
+            _ckpt.save_state(tmp, state)
+            tag = "epoch-%d" % fence.epoch
+            _rejoin.stream_snapshot(store, tmp, tag)
+            newr = SimulatedRank(rid, self)
+            step = _rejoin.rejoin_trainer(
+                newr.trainer, store, tag,
+                membership=newr.membership, view=fence)
+        finally:
+            for p in (tmp, tmp + ".manifest.json"):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+        newr.rebase(fence.epoch)
+        for r in self.live.values():
+            r.membership.request_change(joined=[rid])
+        self.live[rid] = newr
+        return step
+
+    # -- one fenced training step -------------------------------------------
+    def _fence(self):
+        """Apply queued membership changes on every live rank, re-base
+        their virtual fold streams on an epoch move, and assert the
+        survivors converged on ONE view."""
+        for r in self.live.values():
+            before = r.membership.epoch
+            applied = r.membership.apply_pending(trainer=r.trainer,
+                                                 kv=None)
+            if applied is not None and applied.epoch != before:
+                r.rebase(applied.epoch)
+        views = {r.membership.view for r in self.live.values()}
+        if len(views) != 1:
+            raise AssertionError("ranks disagree on the membership view "
+                                 "after the fence: %r" % views)
+        view = views.pop()
+        self.epochs_seen.add(view.epoch)
+        return view
+
+    def _shard_grads(self, rank, x):
+        """One shard's gradients on its owner, as numpy, plus the shard
+        loss (float32)."""
+        import incubator_mxnet_tpu as mx
+        from incubator_mxnet_tpu import autograd
+        xs = mx.nd.array(x)
+        with autograd.record():
+            out = rank.net(xs)
+            loss = (out * out).sum()
+        loss.backward()
+        grads = [np.asarray(p.grad()._read()).copy()
+                 for _n, p in sorted(rank.net.collect_params().items())]
+        return grads, np.float32(np.asarray(loss._read()))
+
+    def step(self):
+        """One fenced, shard-ordered, replicated training step.
+        Returns the step's global loss (float32)."""
+        import incubator_mxnet_tpu as mx
+        view = self._fence()
+        shards = [self._data.randn(self.batch, self.dim).astype(np.float32)
+                  for _ in range(self.n_shards)]
+        summed = None
+        loss = np.float32(0)
+        for s, x in enumerate(shards):
+            owner = self.live[shard_owner(s, view)]
+            grads, l = self._shard_grads(owner, x)
+            loss = np.float32(loss + l)
+            if summed is None:
+                summed = grads
+            else:
+                summed = [np.add(a, g, dtype=a.dtype)
+                          for a, g in zip(summed, grads)]
+        nbytes = sum(int(g.nbytes) for g in summed)
+        digests = []
+        for rid in sorted(self.live):
+            r = self.live[rid]
+            r.fold("reduce_many", len(summed), nbytes)
+            digests.append(r.digest())
+        self.digest_history.append(tuple(digests))
+        for rid in sorted(self.live):
+            r = self.live[rid]
+            params = [p for _n, p in
+                      sorted(r.net.collect_params().items())]
+            for p, g in zip(params, summed):
+                p.grad()[:] = mx.nd.array(g)
+            r.trainer.step(self.batch * self.n_shards)
+        self.step_count += 1
+        self.loss_trajectory.append(loss.tobytes())
+        return loss
+
+    def run(self, n_steps):
+        for _ in range(n_steps):
+            self.step()
+        return self
+
+    def digests_agree(self):
+        """True when every recorded step's live ranks reported one
+        identical (epoch, folds, rolling) digest — the harness's
+        zero-divergence assertion."""
+        return all(len(set(row)) == 1 for row in self.digest_history)

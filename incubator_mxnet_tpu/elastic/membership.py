@@ -1,0 +1,245 @@
+"""Epoch-fenced membership + deterministic re-partition (graftelastic).
+
+The contract, in one paragraph: cluster membership is a sequence of
+**epochs**.  Epoch 0 is the launch membership; every change (a rank
+named dead by the heartbeat table, a replacement rejoining) advances
+the epoch by exactly one and is applied by every survivor **behind the
+same step barrier** — queued on :class:`Membership`, drained by the
+Trainer's step fence — so no two ranks ever run a step under different
+views.  Everything derived from membership (PS key owners, ZeRO
+``shard_owners``, bucket/duplex plans, the lockstep fold stream) is a
+pure function of the new view, recomputed locally by each survivor
+with no coordinator: determinism IS the consensus protocol.
+
+Chaos sites (``GRAFT_FAULTS`` grammar, no grammar change needed):
+
+* ``membership.repartition`` — fired once per applied change on every
+  rank; ``drop`` skips the change (the rank keeps the old view — the
+  lockstep auditor then names it, which is the point), ``delay``/
+  ``error`` behave as everywhere else.
+* ``membership.join`` lives in :mod:`.rejoin`.
+"""
+from __future__ import annotations
+
+import threading
+import zlib
+from collections import deque
+
+import pickle
+
+__all__ = ["MembershipView", "Membership", "key_owner",
+           "repartition_plan", "merge_shard_states",
+           "repartition_shard_states"]
+
+
+class MembershipView(object):
+    """One immutable membership epoch: ``epoch``, the sorted tuple of
+    live ``ranks``, and the delta (``departed``/``joined``) that
+    produced it.  Two survivors computing the next view from the same
+    inputs get equal views — compare with ``==``."""
+
+    __slots__ = ("epoch", "ranks", "departed", "joined")
+
+    def __init__(self, epoch, ranks, departed=(), joined=()):
+        self.epoch = int(epoch)
+        self.ranks = tuple(sorted(int(r) for r in ranks))
+        self.departed = tuple(sorted(int(r) for r in departed))
+        self.joined = tuple(sorted(int(r) for r in joined))
+
+    @property
+    def world_size(self):
+        return len(self.ranks)
+
+    def advance(self, departed=(), joined=()):
+        """The NEXT view after removing ``departed`` and adding
+        ``joined`` — pure, so every survivor derives the same epoch
+        ``self.epoch + 1`` view."""
+        dead = set(int(r) for r in departed)
+        new = set(int(r) for r in joined)
+        ranks = (set(self.ranks) - dead) | new
+        if not ranks:
+            raise ValueError("membership change would leave zero ranks")
+        return MembershipView(self.epoch + 1, ranks,
+                              departed=dead & set(self.ranks),
+                              joined=new - set(self.ranks))
+
+    def __eq__(self, other):
+        return (isinstance(other, MembershipView)
+                and self.epoch == other.epoch
+                and self.ranks == other.ranks)
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    def __hash__(self):
+        return hash((self.epoch, self.ranks))
+
+    def __repr__(self):
+        return ("MembershipView(epoch=%d, ranks=%r, departed=%r, "
+                "joined=%r)" % (self.epoch, self.ranks, self.departed,
+                                self.joined))
+
+    def as_dict(self):
+        return {"epoch": self.epoch, "ranks": list(self.ranks),
+                "departed": list(self.departed),
+                "joined": list(self.joined),
+                "world_size": self.world_size}
+
+
+# -- deterministic re-partition helpers -------------------------------------
+
+def key_owner(key, n_servers):
+    """The server owning ``key`` in an ``n_servers`` group — the exact
+    placement hash the PS wire uses (``GroupClient._shard_of``:
+    ``crc32(str(key)) % N``), exposed so re-partition plans and the PS
+    client can never disagree about where a key lives."""
+    if n_servers <= 0:
+        raise ValueError("n_servers must be positive")
+    return zlib.crc32(str(key).encode()) % int(n_servers)
+
+
+def repartition_plan(keys, old_n, new_n):
+    """The key-movement plan for a server-group resize: ``{key: (old
+    owner, new owner)}`` plus the list of keys whose owner CHANGED
+    (the only ones whose bytes must move).  Pure — every survivor
+    computes the identical plan."""
+    plan = {k: (key_owner(k, old_n), key_owner(k, new_n)) for k in keys}
+    moved = sorted((k for k, (a, b) in plan.items() if a != b), key=str)
+    return plan, moved
+
+
+def merge_shard_states(shard_blobs):
+    """Merge ZeRO-1 optimizer-shard blobs (the pickled
+    ``Updater.get_states(dump_optimizer=True)`` payloads an armor
+    snapshot carries in ``optimizer_shards``) into ONE
+    ``(states, optimizer)`` pair.  Ownership is exclusive — each
+    int-keyed per-param state and each ``__quant_ef__`` residual lives
+    in exactly one shard — so the merge is a disjoint union; iteration
+    order is blob order, making the (theoretical) overlap rule
+    deterministic: later shards win."""
+    merged = {}
+    optimizer = None
+    for blob in shard_blobs:
+        payload = pickle.loads(blob)
+        if isinstance(payload, tuple) and len(payload) == 2:
+            states, opt = payload
+            if opt is not None:
+                optimizer = opt
+        else:
+            states = payload
+        merged.update(states)
+    return merged, optimizer
+
+
+def repartition_shard_states(shard_blobs, new_n):
+    """Deterministically re-partition saved optimizer-shard blobs for a
+    CHANGED world size: merge every saved shard, then hand each of the
+    ``new_n`` new updaters the full merged state dict.  Ownership under
+    ZeRO-1 is *lazy* — an updater context-syncs (rehydrates) only the
+    indices the new ``shard_owners`` bucket map assigns it, at its
+    first fused update; unowned leaves stay host-side numpy and are
+    never uploaded — so shipping the merged dict to every new owner IS
+    the deterministic re-partition, without needing the bucket plan
+    (which does not exist until the first post-restore step).  Returns
+    ``new_n`` pickled blobs in ``set_states`` wire format."""
+    merged, optimizer = merge_shard_states(shard_blobs)
+    payload = (merged, optimizer) if optimizer is not None else merged
+    blob = pickle.dumps(payload)
+    return [blob] * int(new_n)
+
+
+# -- the per-rank state machine ---------------------------------------------
+
+class Membership(object):
+    """One rank's membership state machine.
+
+    Changes are **queued** (:meth:`request_change` — typically from the
+    heartbeat dead-node observer or a supervisor) and **applied** at
+    the step fence (:meth:`apply_pending`, called by ``Trainer.step``
+    when ``GRAFT_ELASTIC=1``, or directly by harnesses), so a
+    re-partition can never land mid-collective.  Applying a change:
+
+    1. fires the ``membership.repartition`` chaos site,
+    2. quiesces the store's duplex wire (``kv.quiesce()`` — satellite
+       fix: in-flight async pushes/pulls drain with a typed timeout
+       BEFORE any key range moves),
+    3. advances the view (pure), re-bases the lockstep fold stream at
+       the new epoch,
+    4. invalidates the trainer's bucket/duplex plans and notifies its
+       ``on_membership_change`` callbacks,
+    5. journals a ``membership_epoch`` flight-recorder event and bumps
+       the ``graft_elastic_*`` metrics.
+    """
+
+    def __init__(self, rank, world_size=None, view=None):
+        if view is None:
+            view = MembershipView(0, range(int(world_size)))
+        self.rank = int(rank)
+        self.view = view
+        self._pending = deque()
+        self._lock = threading.Lock()
+
+    @property
+    def epoch(self):
+        return self.view.epoch
+
+    def request_change(self, departed=(), joined=()):
+        """Queue one membership change for the next step fence."""
+        with self._lock:
+            self._pending.append((tuple(departed), tuple(joined)))
+
+    def pending(self):
+        return bool(self._pending)
+
+    def adopt(self, view):
+        """Adopt an externally-derived view verbatim (the rejoin path:
+        the replacement rank takes the fence epoch it streamed in at
+        rather than replaying the survivors' change history)."""
+        from ..analysis import lockstep as _lockstep
+        with self._lock:
+            self.view = view
+            self._pending.clear()
+        _lockstep.rebase(view.epoch)
+
+    def apply_pending(self, trainer=None, kv=None):
+        """Drain the queue (the step-fence entry point).  Returns the
+        final view when anything was applied, else None."""
+        applied = None
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return applied
+                departed, joined = self._pending.popleft()
+            applied = self._apply(departed, joined, trainer, kv)
+
+    # -- internals ----------------------------------------------------------
+    def _apply(self, departed, joined, trainer, kv):
+        from ..armor import faults as _faults
+        from ..analysis import lockstep as _lockstep
+        from ..telemetry import blackbox as _blackbox
+        from ..telemetry import metrics as _tmetrics
+        new = self.view.advance(departed=departed, joined=joined)
+        verdict = _faults.fault_point(
+            "membership.repartition", epoch=new.epoch,
+            departed=",".join(str(r) for r in new.departed),
+            joined=",".join(str(r) for r in new.joined))
+        if verdict in ("drop", "disconnect"):
+            # this rank skips the re-partition: it keeps the old view on
+            # purpose — the lockstep auditor's epoch-seeded streams then
+            # name it as the diverged rank (chaos proves the detector)
+            return self.view
+        quiesce = getattr(kv, "quiesce", None)
+        if quiesce is not None:
+            quiesce()
+        old_epoch = self.view.epoch
+        self.view = new
+        _lockstep.rebase(new.epoch)
+        if trainer is not None:
+            changed = getattr(trainer, "_membership_changed", None)
+            if changed is not None:
+                changed(new)
+        _blackbox.record("membership_epoch", rank=self.rank,
+                         old_epoch=old_epoch, **new.as_dict())
+        _tmetrics.elastic_epoch(new.epoch)
+        _tmetrics.elastic_repartition(new.world_size)
+        return new

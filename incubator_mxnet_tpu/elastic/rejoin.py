@@ -1,0 +1,188 @@
+"""Checkpoint-streamed rejoin (graftelastic).
+
+A replacement rank does not replay history — it streams the newest
+VALIDATED armor snapshot over the PS wire and joins at the next epoch
+fence.  The stream is the armor file format verbatim (magic + sha256 +
+length + payload, armor/checkpoint.py): a survivor chunks the snapshot
+bytes into ~``GRAFT_BUCKET_BYTES`` uint8 buckets and ``init``s them
+under tagged ``__elastic__/snap/<tag>/…`` keys next to a manifest
+carrying the chunk count and payload sha256; the joiner polls for the
+manifest, pulls the chunks, re-hashes, and loads through the normal
+``load_state`` validation — a torn or corrupt stream surfaces as the
+same typed :class:`~..armor.errors.CheckpointCorruptError` a corrupt
+file would.  Because the snapshot already captures the optimizer-shard
+blobs and ``__quant_ef__`` residuals (PR 19), the departed rank's
+exclusive state rides the same stream with no extra machinery.
+
+Chaos site ``membership.join`` fires once per fetch attempt: ``drop``
+makes that poll find nothing (the joiner retries until its
+``GRAFT_REJOIN_TIMEOUT`` budget expires), ``delay``/``error`` behave
+as everywhere else.
+
+The byte-store interface is the PSClient verb subset ``init(dict)`` /
+``pull(keys) -> dict`` / ``stat(keys) -> dict`` — a real
+:class:`~..parallel.ps.PSClient` works verbatim, and
+:class:`InProcessByteStore` supplies the same verbs for the
+single-process harness and tests.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+
+import numpy as np
+
+__all__ = ["InProcessByteStore", "stream_snapshot", "fetch_snapshot",
+           "rejoin_trainer", "rejoin_timeout", "chunk_bytes",
+           "SNAP_PREFIX"]
+
+SNAP_PREFIX = "__elastic__/snap"
+
+
+def rejoin_timeout():
+    """GRAFT_REJOIN_TIMEOUT in seconds (default 120): the joiner's
+    whole-fetch budget — manifest poll + chunk pulls."""
+    try:
+        t = float(os.environ.get("GRAFT_REJOIN_TIMEOUT", "120"))
+    except ValueError:
+        return 120.0
+    return t if t > 0 else 120.0
+
+
+def chunk_bytes():
+    """Stream chunk size: GRAFT_BUCKET_BYTES (the same knob that sizes
+    gradient buckets — the snapshot rides the wire in the same units),
+    floor 64 KiB."""
+    try:
+        n = int(os.environ.get("GRAFT_BUCKET_BYTES", str(4 << 20)))
+    except ValueError:
+        n = 4 << 20
+    return max(n, 64 << 10)
+
+
+class InProcessByteStore(object):
+    """The PSClient verb subset over a plain dict — the harness/test
+    stand-in for a real parameter-server client (first-write-wins init,
+    copy-out pull, presence-only stat; same semantics as the server's
+    dispatch switch)."""
+
+    def __init__(self):
+        self._store = {}
+
+    def init(self, kv):
+        for k, v in kv.items():
+            self._store.setdefault(k, np.array(v))
+
+    def pull(self, keys):
+        return {k: self._store[k].copy() for k in keys}
+
+    def stat(self, keys):
+        return {k: (tuple(self._store[k].shape), str(self._store[k].dtype))
+                for k in keys if k in self._store}
+
+
+def _keys(tag, n_chunks=None):
+    manifest = "%s/%s/manifest" % (SNAP_PREFIX, tag)
+    if n_chunks is None:
+        return manifest
+    return manifest, ["%s/%s/%06d" % (SNAP_PREFIX, tag, i)
+                      for i in range(n_chunks)]
+
+
+def stream_snapshot(client, path, tag):
+    """Publish one armor snapshot file onto the byte store under
+    ``tag`` (conventionally the fence epoch — PS ``init`` is
+    first-write-wins, so each epoch's stream needs its own tag).
+    Returns the manifest dict."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    csize = chunk_bytes()
+    chunks = [raw[i:i + csize] for i in range(0, len(raw), csize)] or [b""]
+    manifest = {"nchunks": len(chunks), "nbytes": len(raw),
+                "sha256": hashlib.sha256(raw).hexdigest(),
+                "tag": str(tag)}
+    mkey, ckeys = _keys(tag, len(chunks))
+    kv = {k: np.frombuffer(c, dtype=np.uint8)
+          for k, c in zip(ckeys, chunks)}
+    # manifest LAST: its presence is the joiner's ready signal
+    client.init(kv)
+    client.init({mkey: np.frombuffer(
+        json.dumps(manifest, sort_keys=True).encode(), dtype=np.uint8)})
+    from ..telemetry import blackbox as _blackbox
+    _blackbox.record("snapshot_streamed", tag=str(tag),
+                     nbytes=len(raw), nchunks=len(chunks))
+    return manifest
+
+
+def fetch_snapshot(client, tag, timeout=None):
+    """Pull + validate one streamed snapshot; returns the raw armor
+    file bytes.  Polls for the manifest until ``timeout`` (default
+    ``GRAFT_REJOIN_TIMEOUT``) and raises the typed
+    :class:`~..armor.errors.CollectiveTimeoutError` when the stream
+    never appears; a hash mismatch raises
+    :class:`~..armor.errors.CheckpointCorruptError` (stream identity,
+    not availability)."""
+    from ..armor import faults as _faults
+    from ..armor.errors import (CheckpointCorruptError,
+                                CollectiveTimeoutError)
+    budget = rejoin_timeout() if timeout is None else float(timeout)
+    mkey = _keys(tag)
+    t0 = time.monotonic()
+    delay = 0.01
+    while True:
+        verdict = _faults.fault_point("membership.join", tag=str(tag))
+        present = verdict not in ("drop", "disconnect") \
+            and client.stat([mkey]).get(mkey) is not None
+        if present:
+            break
+        age = time.monotonic() - t0
+        if age >= budget:
+            raise CollectiveTimeoutError(
+                "membership.join", age, budget,
+                detail="snapshot stream %r never appeared" % str(tag))
+        time.sleep(min(delay, budget - age))
+        delay = min(delay * 2, 0.25)
+    manifest = json.loads(client.pull([mkey])[mkey].tobytes().decode())
+    _, ckeys = _keys(tag, int(manifest["nchunks"]))
+    fetched = client.pull(ckeys)
+    raw = b"".join(fetched[k].tobytes() for k in ckeys)
+    if len(raw) != int(manifest["nbytes"]) \
+            or hashlib.sha256(raw).hexdigest() != manifest["sha256"]:
+        raise CheckpointCorruptError(
+            "<stream:%s>" % tag, "streamed payload fails its manifest "
+            "hash (%d of %d bytes)" % (len(raw), manifest["nbytes"]))
+    return raw
+
+
+def rejoin_trainer(trainer, client, tag, membership=None, view=None,
+                   timeout=None):
+    """The joiner's whole flow: fetch the streamed snapshot, validate,
+    restore onto ``trainer``, adopt the fence ``view`` on
+    ``membership`` (re-basing the lockstep stream at the fence epoch).
+    Returns the restored step."""
+    import tempfile
+    from ..armor import checkpoint as _ckpt
+    from ..telemetry import blackbox as _blackbox
+    from ..telemetry import metrics as _tmetrics
+    t0 = time.perf_counter()
+    raw = fetch_snapshot(client, tag, timeout=timeout)
+    fd, tmp = tempfile.mkstemp(suffix=".armor")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(raw)
+        state = _ckpt.load_state(tmp)
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    step = _ckpt.restore_trainer(trainer, state)
+    if membership is not None and view is not None:
+        membership.adopt(view)
+    seconds = time.perf_counter() - t0
+    _tmetrics.elastic_rejoin_seconds(seconds, nbytes=len(raw))
+    _blackbox.record("membership_rejoin", tag=str(tag), step=step,
+                     nbytes=len(raw), seconds=round(seconds, 6))
+    return step

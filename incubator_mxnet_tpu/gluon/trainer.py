@@ -57,6 +57,7 @@ import time
 
 import numpy as np
 
+from .. import elastic as _elastic
 from .. import engine as _engine
 from .. import optimizer as opt
 from .. import overlap as _overlap
@@ -106,6 +107,10 @@ class Trainer(object):
         self._pull_scheduler = _overlap.PullScheduler()
         self._bucket_lateness = {}      # param idx -> blocked-wait EWMA
         #                                 (tape-order packing tie-breaker)
+        # graftelastic: membership attachment + change listeners; inert
+        # (two empty attributes) unless GRAFT_ELASTIC wires them up
+        self._membership = None
+        self._membership_cbs = []
         # graftpulse: the trainer is a bucket-bytes / bucket-order
         # target for the lens-driven autotuner (weak registration)
         from ..telemetry import autotune as _autotune
@@ -194,6 +199,36 @@ class Trainer(object):
                               "rate is mutated.")
         self._optimizer.lr = lr
 
+    # -- graftelastic: membership fencing -----------------------------------
+    def attach_membership(self, membership):
+        """Attach this rank's :class:`~..elastic.Membership` state
+        machine: ``step()`` becomes its fence — queued membership
+        changes apply at the top of the next step, never
+        mid-collective."""
+        self._membership = membership
+
+    def on_membership_change(self, fn):
+        """Register ``fn(view)`` to run after every applied membership
+        change (plans already invalidated; ``view`` is the new
+        :class:`~..elastic.MembershipView`).  Returns ``fn`` so it
+        works as a decorator."""
+        self._membership_cbs.append(fn)
+        return fn
+
+    def _membership_changed(self, view):
+        """The re-partition hook :meth:`~..elastic.Membership.apply_pending`
+        calls on this trainer: every world-size-derived artifact —
+        fused/duplex bucket plans, the quantizer's store binding, armed
+        overlap hooks, in-flight pulls — is dropped and rebuilt lazily
+        for the new view on the next step."""
+        self._pull_scheduler.finish()
+        self._scheduler.disarm()
+        self._fused_plan_cache = None
+        self._duplex_plan_cache = None
+        self._quant_cache = None
+        for fn in self._membership_cbs:
+            fn(view)
+
     def step(self, batch_size, ignore_stale_grad=False):
         """Apply one optimization step with grads scaled by 1/batch_size
         (ref: trainer.py:156 step).  Takes the bucketed fused path when
@@ -206,6 +241,14 @@ class Trainer(object):
         self._optimizer.rescale_grad = self._scale / batch_size
         if not self._kv_initialized:
             self._init_kvstore()
+        # graftelastic step fence: queued membership changes land HERE —
+        # between steps, before this step's plan resolves — so a
+        # re-partition can never race a live collective.  Off (the
+        # default) this is one memoized env read.
+        if _elastic.enabled() and self._membership is not None \
+                and self._membership.pending():
+            self._membership.apply_pending(trainer=self,
+                                           kv=self._kvstore_obj)
         if ignore_stale_grad:
             plan = None
         elif self._update_on_kvstore:

@@ -708,6 +708,15 @@ class KVStore(object):
         from .parallel import dist
         dist.barrier()
 
+    def quiesce(self, timeout=None):
+        """Drain every in-flight async operation this store owns
+        (graftelastic: the mandatory prelude to a membership
+        re-partition — key ranges must not move under live traffic).
+        The local store issues nothing asynchronous on its own behalf,
+        so the base is a no-op; ``DistKVStore`` overrides with the real
+        drain and a typed ``QuiesceTimeoutError``."""
+        return 0
+
     def send_command_to_servers(self, head, body):
         return
 

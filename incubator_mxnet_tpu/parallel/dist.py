@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..analysis import lockstep as _lockstep
+from .. import elastic as _elastic
 from ..kvstore import KVStore, PullHandle
 from ..telemetry import blackbox as _blackbox
 from ..telemetry import metrics as _tmetrics
@@ -375,6 +376,57 @@ class DistKVStore(KVStore):
     def barrier(self):
         self._drain_pushes()    # a barrier promises peers see our pushes
         super().barrier()
+
+    @staticmethod
+    def _quiesce_timeout():
+        """GRAFT_QUIESCE_TIMEOUT in seconds (default 30): the drain
+        budget for ``quiesce`` — long enough for a queued push burst,
+        short enough that a dead peer surfaces as a typed error rather
+        than a hung membership fence."""
+        try:
+            t = float(os.environ.get("GRAFT_QUIESCE_TIMEOUT", "30"))
+        except ValueError:
+            return 30.0
+        return t if t > 0 else 30.0
+
+    def quiesce(self, timeout=None):
+        """Drain every in-flight async operation this store owns —
+        queued duplex pushes AND anything riding the background pull
+        thread — within a deadline (graftelastic: the mandatory prelude
+        to a membership re-partition; key ranges must not move under
+        live traffic).  Unlike ``_drain_pushes`` (unbounded, the
+        read-your-writes point) this wait is BOUNDED: work stuck on a
+        dead peer raises :class:`~..armor.errors.QuiesceTimeoutError`
+        naming the undrained count instead of hanging the fence, and
+        the undrained futures stay owned (``close``/``barrier`` still
+        wait them).  A push that FAILED still counts as drained — the
+        wire is quiet either way — but the first failure re-raises
+        after the drain so the caller sees it.  Returns the number of
+        operations drained."""
+        from concurrent.futures import wait as _fwait
+        from ..armor.errors import QuiesceTimeoutError
+        budget = self._quiesce_timeout() if timeout is None \
+            else float(timeout)
+        t0 = time.monotonic()
+        futs, self._push_futs = self._push_futs, []
+        if self._pull_pool is not None:
+            # a sentinel rides the 1-thread FIFO pull executor: when it
+            # runs, every pull submitted before it has finished
+            futs = futs + [self._pull_pool.submit(lambda: None)]
+        done, not_done = _fwait(futs, timeout=budget)
+        if not_done:
+            self._push_futs = list(not_done) + self._push_futs
+            raise QuiesceTimeoutError(
+                "kvstore.quiesce", time.monotonic() - t0, budget,
+                pending=len(not_done))
+        failed = None
+        for f in done:
+            exc = f.exception()
+            if exc is not None and failed is None:
+                failed = exc
+        if failed is not None:
+            raise failed
+        return len(done)
 
     def close(self):
         """Shut down the background PS client (draining queued pushes),
@@ -773,11 +825,17 @@ class DistKVStore(KVStore):
         self._hb_step += 1
         now_ms = int(time.time() * 1000) % (1 << 31)
         audit = _lockstep.enabled()
+        elastic = _elastic.enabled()
         # +1 trailing slot: the graftpulse knob broadcast (rank 0's
         # bucket-bytes proposal; 0 = nothing pending).  Same collective,
         # same shape on every rank — the lockstep hash stays in step.
         base_slots = (6 if audit else 2) * W
-        vec = np.zeros((base_slots + 1,), np.int32)
+        # graftelastic: W MORE per-rank slots after the proposal carry
+        # each rank's membership epoch, so a survivor that fenced a
+        # change names the laggards on the very next heartbeat.  The
+        # SHAPE depends on GRAFT_ELASTIC — set it IDENTICALLY on every
+        # rank, exactly like the audit knob above.
+        vec = np.zeros((base_slots + 1 + (W if elastic else 0),), np.int32)
         vec[rank()] = now_ms
         vec[W + rank()] = self._hb_step % (1 << 31)
         if audit:
@@ -786,6 +844,8 @@ class DistKVStore(KVStore):
             vec[3 * W + rank()] = folds % (1 << 31)
             vec[4 * W + rank()] = lag_hash
             vec[5 * W + rank()] = lag_fold % (1 << 31)
+        if elastic:
+            vec[base_slots + 1 + rank()] = _lockstep.epoch() % (1 << 31)
         if rank() == 0:
             vec[base_slots] = _take_bucket_proposal() % (1 << 31)
         out = np.asarray(_global_sum(jnp.asarray(vec))).astype(np.int64)
@@ -805,6 +865,21 @@ class DistKVStore(KVStore):
             _lockstep.observe({r: (int(folds_by_rank[r]), int(hashes[r]),
                                    int(lag_folds[r]), int(lag_hashes[r]))
                                for r in range(W)}, my_rank=rank())
+        if elastic:
+            epochs = out[base_slots + 1:base_slots + 1 + W]
+            mine = int(epochs[rank()])
+            ahead = int(epochs.max())
+            if ahead > mine:
+                # only the LAGGARD raises: peers that already fenced the
+                # change keep going; this rank must stop issuing
+                # collectives against the stale view and apply its
+                # pending change (or rejoin) before the next step
+                from ..armor.errors import MembershipChangedError
+                raise MembershipChangedError(
+                    mine, ahead, detail="rank(s) %s heartbeat at a newer "
+                    "membership epoch — apply the pending change at the "
+                    "step fence before the next collective" % sorted(
+                        r for r in range(W) if int(epochs[r]) > mine))
         # mod-wrap unwrap: a rank that crossed the 2^31 ms boundary while
         # others have not would otherwise read as ~24 days of skew
         if ts_ms.max() - ts_ms.min() > (1 << 30):

@@ -1084,6 +1084,48 @@ def checkpoint_restored(step):
             "Step counter captured by the last snapshot").set(step)
 
 
+def elastic_epoch(epoch):
+    """One membership-epoch transition applied (elastic/membership.py)."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    r.counter("graft_elastic_epochs_total",
+              "Membership-epoch transitions applied").inc()
+    r.gauge("graft_elastic_epoch",
+            "Current membership epoch of this rank").set(epoch)
+
+
+def elastic_repartition(world_size, moved_keys=0):
+    """One deterministic re-partition run (PS key ranges, shard owners,
+    bucket plans rebuilt for a new world size)."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    r.counter("graft_elastic_repartitions_total",
+              "Deterministic re-partitions run at membership-epoch "
+              "boundaries").inc()
+    r.gauge("graft_elastic_world_size",
+            "Live world size after the last re-partition").set(world_size)
+    if moved_keys:
+        r.counter("graft_elastic_moved_keys_total",
+                  "PS keys whose owning server changed across "
+                  "re-partitions").inc(moved_keys)
+
+
+def elastic_rejoin_seconds(seconds, nbytes=0):
+    """One checkpoint-streamed rejoin completed (elastic/rejoin.py)."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    r.histogram("graft_elastic_rejoin_seconds",
+                "Wall time of one checkpoint-streamed rejoin (fetch + "
+                "validate + restore)", (),
+                buckets=_CKPT_WRITE_BUCKETS).observe(seconds)
+    if nbytes:
+        r.gauge("graft_elastic_rejoin_last_bytes",
+                "Snapshot bytes streamed by the last rejoin").set(nbytes)
+
+
 def serve_shed(model, n=1):
     """Requests shed by the batcher because their deadline expired
     before dispatch (serving/batcher.py load shedding)."""

@@ -1,0 +1,416 @@
+"""graftelastic: live membership change — epoch-fenced re-partition,
+checkpoint-streamed rejoin, quiesce, chaos sites (PR 20).
+
+Single-process coverage via the simulated-N-rank harness
+(``elastic.harness``) plus direct unit tests of the membership algebra,
+the lockstep epoch re-base, the stream protocol, ``quiesce()``, and the
+armor restore-across-world-sizes contract.
+"""
+import os
+import pickle
+import tempfile
+import zlib
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import elastic
+from incubator_mxnet_tpu.analysis import lockstep
+from incubator_mxnet_tpu.armor import checkpoint as ckpt
+from incubator_mxnet_tpu.armor import faults
+from incubator_mxnet_tpu.armor.errors import (CheckpointCorruptError,
+                                              CollectiveTimeoutError,
+                                              FaultInjectedError,
+                                              MembershipChangedError,
+                                              QuiesceTimeoutError,
+                                              ShardOwnershipError)
+from incubator_mxnet_tpu.elastic import (InProcessByteStore, Membership,
+                                         MembershipView, key_owner,
+                                         merge_shard_states,
+                                         repartition_plan,
+                                         repartition_shard_states)
+from incubator_mxnet_tpu.elastic import rejoin as erj
+from incubator_mxnet_tpu.elastic.harness import (SimulatedCluster,
+                                                 shard_owner)
+
+_ENV = ("GRAFT_ELASTIC", "GRAFT_FAULTS", "GRAFT_REJOIN_TIMEOUT",
+        "GRAFT_QUIESCE_TIMEOUT", "GRAFT_BUCKET_BYTES",
+        "GRAFT_SHARD_OPTIMIZER")
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    saved = {k: os.environ.get(k) for k in _ENV}
+    yield
+    faults.reset()
+    elastic.set_enabled(None)
+    lockstep.reset()
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+# -- membership algebra ------------------------------------------------------
+
+def test_view_advance_pure_and_monotonic():
+    v0 = MembershipView(0, range(4))
+    assert v0.world_size == 4 and v0.ranks == (0, 1, 2, 3)
+    v1 = v0.advance(departed=[2])
+    assert v1.epoch == 1 and v1.ranks == (0, 1, 3)
+    assert v1.departed == (2,) and v1.joined == ()
+    assert v0.advance(departed=[2]) == v1           # pure
+    v2 = v1.advance(joined=[5])
+    assert v2.epoch == 2 and v2.ranks == (0, 1, 3, 5)
+    with pytest.raises(ValueError):
+        MembershipView(0, [0]).advance(departed=[0])
+
+
+def test_key_owner_matches_ps_wire_hash():
+    from incubator_mxnet_tpu.parallel import ps
+    for k in ("w0", "dense0_weight", 17, "__quant_ef__/f32:0"):
+        for n in (1, 2, 3, 5):
+            assert key_owner(k, n) == zlib.crc32(str(k).encode()) % n
+    # mirrors GroupClient placement exactly
+    gc = object.__new__(ps.GroupClient)
+    gc._n = 3
+    assert all(key_owner(k, 3) == gc._shard_of(str(k))
+               for k in ("a", "b", "c", "w17"))
+
+
+def test_repartition_plan_minimal_and_order_free():
+    keys = ["w%d" % i for i in range(64)]
+    plan, moved = repartition_plan(keys, 4, 3)
+    assert repartition_plan(list(reversed(keys)), 4, 3) == (plan, moved)
+    assert all(plan[k][0] != plan[k][1] for k in moved)
+    unmoved = [k for k in keys if k not in moved]
+    assert all(plan[k][0] == plan[k][1] for k in unmoved)
+    assert repartition_plan(keys, 4, 4)[1] == []
+
+
+@pytest.mark.parametrize("old_n,new_n", [(2, 4), (4, 2)])
+def test_shard_state_repartition_both_directions(old_n, new_n):
+    blobs = [pickle.dumps(({i: "state%d" % i,
+                            "__quant_ef__/float32:%d" % i: "ef%d" % i},
+                           "OPT" if i == 0 else None))
+             for i in range(old_n)]
+    merged, opt = merge_shard_states(blobs)
+    assert opt == "OPT"
+    assert set(merged) == (set(range(old_n))
+                           | {"__quant_ef__/float32:%d" % i
+                              for i in range(old_n)})
+    out = repartition_shard_states(blobs, new_n)
+    assert len(out) == new_n and len(set(out)) == 1
+    assert out == repartition_shard_states(blobs, new_n)   # deterministic
+    re_merged, re_opt = merge_shard_states(out[:1])
+    assert re_merged == merged and re_opt == "OPT"
+
+
+# -- lockstep epoch re-base --------------------------------------------------
+
+def test_lockstep_epoch_base_and_fold_value():
+    assert lockstep.epoch_base(0) == 0
+    b1, b2 = lockstep.epoch_base(1), lockstep.epoch_base(2)
+    assert b1 != b2 and b1 == lockstep.epoch_base(1)
+    r = lockstep.fold_value(b1, 1, "reduce_many", 4, 1024)
+    assert r == lockstep.fold_value(b1, 1, "reduce_many", 4, 1024)
+    assert r != lockstep.fold_value(b2, 1, "reduce_many", 4, 1024)
+
+
+def test_lockstep_rebase_reseeds_and_keeps_divergence():
+    lockstep.reset()
+    lockstep.rebase(3)
+    snap = lockstep.snapshot()
+    assert snap["epoch"] == 3
+    assert snap["rolling_hash"] == lockstep.epoch_base(3)
+    assert snap["folds"] == 0
+    lockstep.reset()
+    assert lockstep.snapshot()["epoch"] == 0
+
+
+# -- the per-rank state machine + step fence ---------------------------------
+
+def test_membership_queue_and_fence():
+    m = Membership(0, world_size=3)
+    assert m.epoch == 0 and not m.pending()
+    m.request_change(departed=[1])
+    m.request_change(joined=[1])
+    assert m.pending()
+    final = m.apply_pending()
+    assert final.epoch == 2 and final.ranks == (0, 1, 2)
+    assert not m.pending() and m.apply_pending() is None
+
+
+def test_repartition_drop_keeps_old_view_deterministically():
+    faults.configure("membership.repartition:drop:times=1")
+    launch = MembershipView(0, range(3))
+    lag, ok = Membership(0, view=launch), Membership(2, view=launch)
+    for m in (lag, ok):
+        m.request_change(departed=[1])
+    lag.apply_pending()
+    ok.apply_pending()
+    assert (lag.epoch, ok.epoch) == (0, 1)
+    faults.reset()
+    # the dropped change is consumed, not replayed
+    lag.apply_pending()
+    assert lag.epoch == 0 and not lag.pending()
+
+
+def test_join_chaos_seeded_replay_is_deterministic():
+    def verdicts(n):
+        faults.configure("membership.join:error:p=0.5:seed=13:times=100")
+        out = []
+        for _ in range(n):
+            try:
+                faults.fault_point("membership.join", tag="t")
+                out.append(False)
+            except FaultInjectedError:
+                out.append(True)
+        return out
+    a, b = verdicts(24), verdicts(24)
+    assert a == b and any(a) and not all(a)
+
+
+def test_trainer_step_fence_gated_on_elastic(simple_trainer=None):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, random_state
+    random_state.seed(7)
+    net = gluon.nn.Dense(3, prefix="fence_test_")
+    net.initialize(ctx=mx.cpu())
+    x = mx.nd.array(np.random.RandomState(0).randn(2, 5).astype(np.float32))
+    net(x)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    m = Membership(0, world_size=4)
+    trainer.attach_membership(m)
+    seen = []
+    trainer.on_membership_change(lambda view: seen.append(view.epoch))
+    m.request_change(departed=[3])
+
+    def step():
+        with autograd.record():
+            loss = (net(x) * net(x)).sum()
+        loss.backward()
+        trainer.step(2)
+
+    elastic.set_enabled(False)
+    step()
+    assert m.epoch == 0 and m.pending() and seen == []
+    elastic.set_enabled(True)
+    step()
+    assert m.epoch == 1 and not m.pending() and seen == [1]
+
+
+def test_enabled_memoizes_env():
+    os.environ.pop("GRAFT_ELASTIC", None)
+    elastic.set_enabled(None)
+    assert elastic.enabled() is False
+    os.environ["GRAFT_ELASTIC"] = "1"
+    assert elastic.enabled() is True
+    os.environ["GRAFT_ELASTIC"] = "off"
+    assert elastic.enabled() is False
+    elastic.set_enabled(True)
+    assert elastic.enabled() is True
+
+
+# -- quiesce -----------------------------------------------------------------
+
+def test_base_kvstore_quiesce_is_noop():
+    kv = mx.kv.create("local")
+    assert kv.quiesce() == 0
+    assert kv.quiesce(timeout=0.01) == 0
+
+
+def _bare_dist_kv():
+    from incubator_mxnet_tpu.parallel.dist import DistKVStore
+    kv = object.__new__(DistKVStore)
+    kv._push_futs = []
+    kv._pull_pool = None
+    return kv
+
+
+def test_quiesce_timeout_is_typed_and_keeps_ownership():
+    kv = _bare_dist_kv()
+    stuck = Future()
+    kv._push_futs = [stuck]
+    with pytest.raises(QuiesceTimeoutError) as ei:
+        kv.quiesce(timeout=0.05)
+    exc = ei.value
+    assert isinstance(exc, CollectiveTimeoutError)
+    assert exc.site == "kvstore.quiesce" and exc.pending == 1
+    assert kv._push_futs == [stuck]       # still owned for barrier/close
+    stuck.set_result(None)
+    assert kv.quiesce(timeout=1.0) == 1
+    assert kv._push_futs == []
+
+
+def test_quiesce_surfaces_failure_after_drain():
+    kv = _bare_dist_kv()
+    good, bad = Future(), Future()
+    good.set_result(None)
+    bad.set_exception(RuntimeError("wire died"))
+    kv._push_futs = [good, bad]
+    with pytest.raises(RuntimeError, match="wire died"):
+        kv.quiesce(timeout=1.0)
+    assert kv._push_futs == []            # drained despite the failure
+
+
+def test_quiesce_timeout_env_default():
+    from incubator_mxnet_tpu.parallel.dist import DistKVStore
+    os.environ["GRAFT_QUIESCE_TIMEOUT"] = "7.5"
+    assert DistKVStore._quiesce_timeout() == 7.5
+    os.environ["GRAFT_QUIESCE_TIMEOUT"] = "junk"
+    assert DistKVStore._quiesce_timeout() == 30.0
+    os.environ.pop("GRAFT_QUIESCE_TIMEOUT", None)
+    assert DistKVStore._quiesce_timeout() == 30.0
+
+
+# -- the rejoin stream -------------------------------------------------------
+
+def test_stream_roundtrip_and_chunking():
+    os.environ["GRAFT_BUCKET_BYTES"] = str(64 << 10)   # floor: forces chunks
+    store = InProcessByteStore()
+    payload = os.urandom(200 << 10)
+    fd, tmp = tempfile.mkstemp()
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+        man = erj.stream_snapshot(store, tmp, "t1")
+        assert man["nchunks"] == 4 and man["nbytes"] == len(payload)
+        assert erj.fetch_snapshot(store, "t1", timeout=2.0) == payload
+    finally:
+        os.unlink(tmp)
+
+
+def test_fetch_absent_stream_times_out_typed():
+    faults.reset()
+    with pytest.raises(CollectiveTimeoutError) as ei:
+        erj.fetch_snapshot(InProcessByteStore(), "missing", timeout=0.2)
+    assert ei.value.site == "membership.join"
+
+
+def test_fetch_torn_stream_raises_corrupt():
+    import hashlib
+    import json
+    store = InProcessByteStore()
+    raw = b"x" * 1000
+    mkey, ckeys = erj._keys("torn", 1)
+    store.init({ckeys[0]: np.frombuffer(raw[:-1], np.uint8)})
+    store.init({mkey: np.frombuffer(json.dumps(
+        {"nchunks": 1, "nbytes": len(raw),
+         "sha256": hashlib.sha256(raw).hexdigest(), "tag": "torn"},
+        sort_keys=True).encode(), np.uint8)})
+    with pytest.raises(CheckpointCorruptError):
+        erj.fetch_snapshot(store, "torn", timeout=2.0)
+
+
+def test_join_drop_consumes_budget_not_stream():
+    store = InProcessByteStore()
+    fd, tmp = tempfile.mkstemp()
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(b"payload-bytes")
+        erj.stream_snapshot(store, tmp, "t2")
+        faults.configure("membership.join:drop:n=1")
+        # first poll is dropped, second finds the manifest
+        assert erj.fetch_snapshot(store, "t2",
+                                  timeout=5.0) == b"payload-bytes"
+        faults.configure("membership.join:drop")       # every poll dropped
+        with pytest.raises(CollectiveTimeoutError):
+            erj.fetch_snapshot(store, "t2", timeout=0.2)
+    finally:
+        os.unlink(tmp)
+
+
+# -- the simulated cluster: kill + rejoin byte parity ------------------------
+
+def test_kill_rejoin_byte_parity_across_epochs():
+    base = SimulatedCluster(3).run(6)
+    assert base.digests_agree()
+    c = SimulatedCluster(3)
+    c.run(2)
+    c.kill(1)
+    c.run(2)
+    c.rejoin(1)
+    c.run(2)
+    assert sorted(c.epochs_seen) == [0, 1, 2]
+    assert c.digests_agree()
+    assert c.loss_trajectory == base.loss_trajectory
+    assert c.params_bytes() == base.params_bytes()
+    assert c.params_bytes(1) == c.params_bytes(0)
+
+
+def test_shard_owner_is_pure_in_view():
+    v = MembershipView(4, [0, 2, 3])
+    owners = [shard_owner(s, v) for s in range(6)]
+    assert owners == [0, 2, 3, 0, 2, 3]
+    assert owners == [shard_owner(s, MembershipView(4, [3, 0, 2]))
+                      for s in range(6)]    # rank order never matters
+
+
+# -- armor restore across a changed world size (satellite 6) -----------------
+
+def _tiny_trainer(seed=3):
+    from incubator_mxnet_tpu import autograd, gluon, random_state
+    random_state.seed(seed)
+    net = gluon.nn.Dense(4, prefix="elastic_ckpt_")
+    net.initialize(ctx=mx.cpu())
+    rs = np.random.RandomState(seed)
+    x = mx.nd.array(rs.randn(2, 6).astype(np.float32))
+    net(x)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    with autograd.record():
+        loss = (net(x) * net(x)).sum()
+    loss.backward()
+    trainer.step(2)                 # momentum state materializes
+    return net, trainer
+
+
+@pytest.mark.parametrize("old_n,new_n", [(2, 4), (4, 2)])
+def test_restore_across_world_size(old_n, new_n):
+    _, t1 = _tiny_trainer()
+    t1._zero_spec = lambda: {"axis": "ctx", "n": old_n, "rank": 0}
+    state = ckpt.snapshot_trainer(t1, 9)
+    assert state["shard"]["n"] == old_n
+    assert "membership_epoch" in state
+
+    net2, t2 = _tiny_trainer()
+    t2._zero_spec = lambda: {"axis": "ctx", "n": new_n, "rank": 1}
+    elastic.set_enabled(False)
+    with pytest.raises(ShardOwnershipError) as ei:
+        ckpt.restore_trainer(t2, state)
+    assert ei.value.epoch is not None
+    assert "GRAFT_ELASTIC" in str(ei.value)
+
+    elastic.set_enabled(True)
+    assert ckpt.restore_trainer(t2, state) == 9
+    got = {n: np.asarray(p.data()._read()).tobytes()
+           for n, p in net2.collect_params().items()}
+    net3, t3 = _tiny_trainer()
+    t3._zero_spec = lambda: {"axis": "ctx", "n": new_n, "rank": 1}
+    assert ckpt.restore_trainer(t3, state) == 9
+    assert {n: np.asarray(p.data()._read()).tobytes()
+            for n, p in net3.collect_params().items()} == got
+
+
+def test_restore_axis_change_refuses_even_with_elastic():
+    _, t1 = _tiny_trainer()
+    t1._zero_spec = lambda: {"axis": "ctx", "n": 2, "rank": 0}
+    state = ckpt.snapshot_trainer(t1, 1)
+    _, t2 = _tiny_trainer()
+    t2._zero_spec = lambda: {"axis": "worker", "n": 2, "rank": 0}
+    elastic.set_enabled(True)
+    with pytest.raises(ShardOwnershipError):
+        ckpt.restore_trainer(t2, state)
+
+
+def test_membership_changed_error_fields():
+    exc = MembershipChangedError(2, 4, departed=[1], joined=[5],
+                                 detail="peer ahead")
+    assert exc.old_epoch == 2 and exc.new_epoch == 4
+    assert exc.departed == (1,) and exc.joined == (5,)
+    assert "epoch 2 -> 4" in str(exc) and "peer ahead" in str(exc)

@@ -94,6 +94,21 @@
 #    the GRAFT_QUANT_REDUCE=0 escape hatch (bit-identical, < 2%
 #    overhead) and the ZeRO-1 shard parity + ~1/N state-bytes claim via
 #    an 8-device child run.
+# 14. graftelastic smoke — elastic --selftest runs kill → re-partition →
+#    rejoin → byte-parity in one subprocess: the membership algebra and
+#    re-partition plans are pure/deterministic, a simulated 3-rank
+#    cluster that loses and regains a rank reproduces the unfaulted loss
+#    trajectory byte-for-byte with lockstep digests agreeing across two
+#    membership epochs, a chunked armor snapshot round-trips through a
+#    REAL ParameterServer wire (torn stream -> typed corruption error),
+#    seeded membership.join/repartition chaos replays deterministically
+#    (drop -> the rank keeps the old epoch; stuck quiesce -> typed
+#    QuiesceTimeoutError), ZeRO shard state re-partitions across changed
+#    world sizes both directions (refusing with ShardOwnershipError when
+#    GRAFT_ELASTIC is off), and GRAFT_ELASTIC=0 leaves the step fence
+#    untaken; bench_eager --smoke (tier 3) additionally gates
+#    elastic_overhead_pct (enabled-idle fence) against its < 2% budget
+#    in BENCH JSON.
 #
 # Usage: tools/run_lint.sh [report.json]
 set -uo pipefail
@@ -133,5 +148,8 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     || exit $?
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m incubator_mxnet_tpu.parallel.quant --selftest \
+    || exit $?
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m incubator_mxnet_tpu.elastic --selftest \
     || exit $?
 exec python -m incubator_mxnet_tpu.telemetry --selftest
